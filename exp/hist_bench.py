@@ -1,0 +1,190 @@
+"""Micro-benchmark: histogram-build strategies on TPU.
+
+The core op of a histogram GBDT is: for each feature f and bin b,
+  hist[f, b, c] = sum_r onehot(x[r,f]==b) * w[r, c]   (c = grad/hess/count channels)
+
+Reference does this with scatter-adds (CPU) / local-memory atomics (OpenCL,
+/root/reference/src/treelearner/ocl/histogram256.cl). TPUs have no fast scatter,
+so we compare MXU/VPU-friendly formulations to pick the framework's kernel design.
+
+Run:  python exp/hist_bench.py [N] [B]
+"""
+import sys
+import time
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2**21
+B = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+F = 28
+K = F * B  # flattened (feature, bin) one-hot width
+R = 16384  # row chunk
+
+
+def timeit(fn, *args, n=5):
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+rng = np.random.default_rng(0)
+x_host = rng.integers(0, B, size=(N, F), dtype=np.uint8)
+g_host = rng.standard_normal(N).astype(np.float32)
+h_host = np.ones(N, dtype=np.float32)
+
+x = jnp.asarray(x_host)
+g = jnp.asarray(g_host)
+h = jnp.asarray(h_host)
+offsets = jnp.arange(F, dtype=jnp.int32) * B  # [F]
+
+C = 8  # channel columns (g_hi, g_lo, h_hi, h_lo, count, pad...)
+CPAD = 128
+
+
+def make_rhs(gc, hc, cols):
+    """[R, cols] bf16 RHS: g/h split hi/lo for f32-ish precision, count, zero pad."""
+    g_hi = gc.astype(jnp.bfloat16)
+    g_lo = (gc - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    h_hi = hc.astype(jnp.bfloat16)
+    h_lo = (hc - h_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    ones = jnp.ones_like(g_hi)
+    w = jnp.stack([g_hi, g_lo, h_hi, h_lo, ones], axis=-1)  # [R, 5]
+    return jnp.pad(w, ((0, 0), (0, cols - 5)))
+
+
+@jax.jit
+def hist_flat_onehot(x, g, h):
+    """einsum 'rk,rc->kc' with flattened (f,b) one-hot, C=8 cols."""
+    nchunk = N // R
+
+    def body(acc, idx):
+        xc = jax.lax.dynamic_slice_in_dim(x, idx * R, R)  # [R, F] uint8
+        gc = jax.lax.dynamic_slice_in_dim(g, idx * R, R)
+        hc = jax.lax.dynamic_slice_in_dim(h, idx * R, R)
+        key = xc.astype(jnp.int32) + offsets[None, :]  # [R, F]
+        onehot = jax.nn.one_hot(key, K, dtype=jnp.bfloat16).sum(axis=1)  # [R, K]
+        rhs = make_rhs(gc, hc, C)
+        acc = acc + jax.lax.dot_general(
+            onehot, rhs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, ()
+
+    acc = jnp.zeros((K, C), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc, jnp.arange(nchunk))
+    return acc
+
+
+@jax.jit
+def hist_flat_onehot_cmp(x, g, h):
+    """Same but one-hot built by per-feature compare then reshape (no sum over F)."""
+    nchunk = N // R
+    iota_b = jnp.arange(B, dtype=jnp.uint8)[None, None, :]
+
+    def body(acc, idx):
+        xc = jax.lax.dynamic_slice_in_dim(x, idx * R, R)
+        gc = jax.lax.dynamic_slice_in_dim(g, idx * R, R)
+        hc = jax.lax.dynamic_slice_in_dim(h, idx * R, R)
+        onehot = (xc[:, :, None] == iota_b).astype(jnp.bfloat16).reshape(R, K)
+        rhs = make_rhs(gc, hc, C)
+        acc = acc + jax.lax.dot_general(
+            onehot, rhs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, ()
+
+    acc = jnp.zeros((K, C), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc, jnp.arange(nchunk))
+    return acc
+
+
+@jax.jit
+def hist_batched_feature(x, g, h):
+    """einsum 'rfb,rc->fbc' batched over features."""
+    nchunk = N // R
+    iota_b = jnp.arange(B, dtype=jnp.uint8)[None, None, :]
+
+    def body(acc, idx):
+        xc = jax.lax.dynamic_slice_in_dim(x, idx * R, R)
+        gc = jax.lax.dynamic_slice_in_dim(g, idx * R, R)
+        hc = jax.lax.dynamic_slice_in_dim(h, idx * R, R)
+        onehot = (xc[:, :, None] == iota_b).astype(jnp.bfloat16)  # [R, F, B]
+        rhs = make_rhs(gc, hc, C)  # [R, C]
+        acc = acc + jax.lax.dot_general(
+            onehot, rhs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [F, B, C]? no: contract r -> [F,B,C]
+        return acc, ()
+
+    acc = jnp.zeros((F, B, C), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc, jnp.arange(nchunk))
+    return acc
+
+
+@jax.jit
+def hist_scatter(x, g, h):
+    """XLA scatter-add over flattened keys (the 'reference-style' formulation)."""
+    key = (x.astype(jnp.int32) + offsets[None, :]).reshape(-1)  # [N*F]
+    hist_g = jnp.zeros((K,), jnp.float32).at[key].add(jnp.repeat(g, F))
+    hist_h = jnp.zeros((K,), jnp.float32).at[key].add(jnp.repeat(h, F))
+    hist_c = jnp.zeros((K,), jnp.float32).at[key].add(1.0)
+    return jnp.stack([hist_g, hist_h, hist_c], -1)
+
+
+@jax.jit
+def onehot_build_only(x):
+    """Isolate the one-hot construction cost."""
+    nchunk = N // R
+    iota_b = jnp.arange(B, dtype=jnp.uint8)[None, None, :]
+
+    def body(acc, idx):
+        xc = jax.lax.dynamic_slice_in_dim(x, idx * R, R)
+        onehot = (xc[:, :, None] == iota_b).astype(jnp.bfloat16)
+        acc = acc + onehot.sum(axis=(0, 1))
+        return acc, ()
+
+    acc = jnp.zeros((B,), jnp.float32).astype(jnp.bfloat16)
+    acc, _ = jax.lax.scan(body, acc, jnp.arange(nchunk))
+    return acc
+
+
+@jax.jit
+def matmul_only(a, b):
+    return a @ b
+
+
+def main():
+    print(f"N={N} F={F} B={B} K={K} R={R} dev={jax.devices()[0]}")
+    results = {}
+    for name, fn, args in [
+        ("flat_onehot_sum", hist_flat_onehot, (x, g, h)),
+        ("flat_onehot_cmp", hist_flat_onehot_cmp, (x, g, h)),
+        ("batched_feature", hist_batched_feature, (x, g, h)),
+        ("onehot_build_only", onehot_build_only, (x,)),
+    ]:
+        try:
+            t = timeit(fn, *args)
+            results[name] = t
+            print(f"{name:24s} {t*1e3:9.2f} ms   ({N/t/1e9:.2f} Grows/s)")
+        except Exception as e:
+            print(f"{name:24s} FAILED: {type(e).__name__}: {str(e)[:200]}")
+    # scatter only at small N (can be pathologically slow)
+    if N <= 2**21:
+        try:
+            t = timeit(hist_scatter, x, g, h, n=2)
+            print(f"{'scatter':24s} {t*1e3:9.2f} ms   ({N/t/1e9:.2f} Grows/s)")
+        except Exception as e:
+            print(f"{'scatter':24s} FAILED: {str(e)[:200]}")
+    # raw MXU reference: [R,K]x[K,CPAD] bf16
+    a = jnp.ones((N // 64, K), jnp.bfloat16)
+    b = jnp.ones((K, CPAD), jnp.bfloat16)
+    t = timeit(matmul_only, a, b)
+    flops = 2 * (N // 64) * K * CPAD
+    print(f"{'raw_matmul_ref':24s} {t*1e3:9.2f} ms   ({flops/t/1e12:.1f} TFLOP/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""lightgbm_tpu — a TPU-native gradient boosting framework.
+
+A from-scratch reimplementation of the capabilities of LightGBM v2.0.10
+(reference: bwilbertz/LightGBM) designed TPU-first: the binned dataset lives in
+HBM as a dense uint8 matrix, gradient/hessian histograms are built by one-hot
+bf16 matmuls on the MXU, best-split search is a vectorized two-direction scan
+over the bin axis, and tree growth runs device-side under `jax.jit` in
+"waves" of leaf splits. Distributed training (`tree_learner=data|feature|voting`)
+uses XLA collectives over a `jax.sharding.Mesh` instead of the reference's
+socket/MPI allreduce stack (reference: src/network/).
+
+Public API mirrors the reference Python package (python-package/lightgbm):
+`Dataset`, `Booster`, `train`, `cv`, sklearn estimators, callbacks.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config
+from .basic import Booster, Dataset
+from .engine import train, cv
+from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
+from .sklearn import LGBMModel, LGBMClassifier, LGBMRegressor, LGBMRanker
+
+__all__ = [
+    "Config",
+    "Dataset",
+    "Booster",
+    "train",
+    "cv",
+    "early_stopping",
+    "log_evaluation",
+    "record_evaluation",
+    "reset_parameter",
+    "LGBMModel",
+    "LGBMClassifier",
+    "LGBMRegressor",
+    "LGBMRanker",
+]

@@ -1,0 +1,343 @@
+"""User-facing Dataset and Booster, mirroring the reference Python package
+(python-package/lightgbm/basic.py: Dataset at :556, Booster at :1234) — but
+backed by the TPU pipeline instead of ctypes into lib_lightgbm.so.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .dataset import ConstructedDataset, Metadata, construct_dataset
+from .tree import Tree
+from .utils.log import Log, LightGBMError
+
+
+def _to_2d_float(data) -> np.ndarray:
+    if hasattr(data, "values") and hasattr(data, "columns"):  # pandas DataFrame
+        return data.values.astype(np.float64, copy=False), [str(c) for c in data.columns]
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(1, -1)
+    return arr, None
+
+
+class Dataset:
+    """Lazily-constructed training dataset (reference basic.py:556).
+
+    Binning happens at first use (`_lazy_construct`, reference basic.py:698);
+    validation sets built with `reference=` share the training set's
+    BinMappers (the analog of LoadFromFileAlignWithOtherDataset).
+    """
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = False, silent: bool = False):
+        if isinstance(data, str):
+            from .io.file_io import load_data_file
+            data, file_label, side = load_data_file(data, params or {})
+            if label is None:
+                label = file_label
+            if weight is None:
+                weight = side.get("weight")
+            if group is None:
+                group = side.get("group")
+            if init_score is None:
+                init_score = side.get("init_score")
+            if feature_name == "auto" and side.get("feature_names"):
+                feature_name = side["feature_names"]
+        self.raw_data, inferred_names = _to_2d_float(data)
+        self.label = None if label is None else np.asarray(label).reshape(-1)
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name if feature_name != "auto" else inferred_names
+        self.categorical_feature = None if categorical_feature == "auto" else categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._constructed: Optional[ConstructedDataset] = None
+        self._binned_aligned: Optional[np.ndarray] = None
+
+    # -- construction --------------------------------------------------------
+
+    def construct(self, config: Optional[Config] = None) -> "Dataset":
+        if self._constructed is not None or self._binned_aligned is not None:
+            return self
+        if self.reference is not None:
+            ref = self.reference
+            ref.construct(config)
+            self._binned_aligned = ref._constructed.bin_raw(self.raw_data)
+            meta = Metadata(self.raw_data.shape[0])
+            if self.label is not None:
+                meta.set_label(self.label)
+            meta.set_weight(self.weight)
+            meta.set_group(self.group)
+            meta.set_init_score(self.init_score)
+            self._metadata = meta
+        else:
+            cfg = config or Config.from_params(self.params)
+            self._constructed = construct_dataset(
+                self.raw_data, self.label, cfg,
+                weight=self.weight, group=self.group, init_score=self.init_score,
+                feature_names=self.feature_name,
+                categorical_features=self.categorical_feature)
+        if self.free_raw_data:
+            self.raw_data = None
+        return self
+
+    @property
+    def constructed(self) -> ConstructedDataset:
+        if self._constructed is None:
+            self.construct()
+        return self._constructed
+
+    # -- introspection (reference basic.py Dataset API) ----------------------
+
+    def num_data(self) -> int:
+        if self._constructed is not None:
+            return self._constructed.num_data
+        return self.raw_data.shape[0]
+
+    def num_feature(self) -> int:
+        if self._constructed is not None:
+            return self._constructed.num_total_features
+        return self.raw_data.shape[1]
+
+    def get_label(self):
+        return self.label
+
+    def set_label(self, label):
+        self.label = None if label is None else np.asarray(label).reshape(-1)
+        if self._constructed is not None and self.label is not None:
+            self._constructed.metadata.set_label(self.label)
+        return self
+
+    def get_weight(self):
+        return self.weight
+
+    def set_weight(self, weight):
+        self.weight = weight
+        if self._constructed is not None:
+            self._constructed.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group):
+        self.group = group
+        if self._constructed is not None:
+            self._constructed.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score):
+        self.init_score = init_score
+        if self._constructed is not None:
+            self._constructed.metadata.set_init_score(init_score)
+        return self
+
+    def get_field(self, name):
+        return {"label": self.label, "weight": self.weight,
+                "group": self.group, "init_score": self.init_score}[name]
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self.constructed.save_binary(filename)
+        return self
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, params=params)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        idx = np.asarray(used_indices)
+        init_score = None
+        if self.init_score is not None:
+            is_arr = np.asarray(self.init_score)
+            init_score = is_arr[idx] if is_arr.ndim == 1 and len(is_arr) == self.num_data() \
+                else is_arr
+        if self.group is not None:
+            # row-level subsetting would break query structure; callers doing
+            # ranking CV must fold at query granularity (engine.cv handles it)
+            Log.fatal("Cannot subset a Dataset with group/query information by rows")
+        return Dataset(self.raw_data[idx],
+                       label=None if self.label is None else self.label[idx],
+                       weight=None if self.weight is None else np.asarray(self.weight)[idx],
+                       init_score=init_score,
+                       params=params or self.params,
+                       feature_name=self.feature_name,
+                       categorical_feature=self.categorical_feature)
+
+
+class Booster:
+    """Trained model handle (reference basic.py:1234).
+
+    Training happens through `train()`/`update()`; the trained forest lives as
+    host `Tree` objects for prediction/serialization while training state
+    (scores, binned data) stays on device inside the internal GBDT driver.
+    """
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None, model_str: Optional[str] = None,
+                 silent: bool = False):
+        self.params = dict(params or {})
+        self.config = Config.from_params(self.params)
+        self._gbdt = None
+        self.trees: List[Tree] = []          # flattened tree list (iter-major)
+        self.num_model_per_iteration = 1
+        self.best_iteration = 0
+        self.best_score: Dict = {}
+        self.feature_names: List[str] = []
+        self.num_total_features = 0
+        self.mappers = []
+        self.init_score_value = 0.0
+        self.pandas_categorical = None
+        if model_file is not None:
+            from .io.model_text import load_model_file
+            load_model_file(self, model_file)
+        elif model_str is not None:
+            from .io.model_text import load_model_string
+            load_model_string(self, model_str)
+        elif train_set is not None:
+            self._setup_train(train_set)
+
+    # -- training ------------------------------------------------------------
+
+    def _setup_train(self, train_set: Dataset) -> None:
+        from .boosting import create_boosting
+        train_set.params.update(self.params)
+        train_set.construct(self.config)
+        cd = train_set.constructed
+        self._gbdt = create_boosting(self.config, cd)
+        self.train_dataset = train_set
+        self.feature_names = cd.feature_names
+        self.num_total_features = cd.num_total_features
+        self.mappers = cd.mappers
+        self._real_feature_idx = cd.real_feature_idx
+        self.num_model_per_iteration = self._gbdt.num_models
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct(self.config)
+        if data.reference is None or data._binned_aligned is None:
+            Log.fatal("Add valid data failed: valid set must reference the training set")
+        self._gbdt.add_valid(name, data._binned_aligned, data._metadata)
+        return self
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration (reference LGBM_BoosterUpdateOneIter)."""
+        if fobj is not None:
+            Log.fatal("Custom objective in update() lands with the custom-fobj milestone")
+        self._gbdt.train_one_iter()
+        return False
+
+    def _finalize(self):
+        forest = self._gbdt.finalize_model()
+        self.trees = [t for it_trees in forest for t in it_trees]
+        self.init_score_value = self._gbdt.init_score_value
+        self.best_iteration = getattr(self._gbdt, "best_iteration", 0)
+
+    # -- prediction ----------------------------------------------------------
+
+    def num_trees(self) -> int:
+        return len(self.trees)
+
+    def current_iteration(self) -> int:
+        return len(self.trees) // max(self.num_model_per_iteration, 1)
+
+    def predict(self, data, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        if hasattr(data, "values") and hasattr(data, "columns"):
+            data = data.values
+        X = np.asarray(data, dtype=np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        K = max(self.num_model_per_iteration, 1)
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else \
+                len(self.trees) // K
+        use_trees = self.trees[: num_iteration * K]
+
+        if pred_leaf:
+            out = np.stack([t.predict_leaf(X) for t in use_trees], axis=1)
+            return out
+        raw = np.zeros((K, X.shape[0]), dtype=np.float64)
+        for i, t in enumerate(use_trees):
+            raw[i % K] += t.predict(X)
+        if self.config.boosting_normalized == "rf":
+            # average of already-converted tree outputs (rf.hpp average_output_)
+            raw /= max(len(use_trees) // K, 1)
+        elif not raw_score:
+            raw = self._convert_output(raw)
+        return raw[0] if K == 1 else raw.T
+
+    def _convert_output(self, raw: np.ndarray) -> np.ndarray:
+        obj = self.config.objective
+        from .objectives import OBJECTIVE_ALIASES
+        name = OBJECTIVE_ALIASES.get(obj, obj)
+        if name == "binary":
+            return 1.0 / (1.0 + np.exp(-self.config.sigmoid * raw))
+        if name == "multiclass":
+            e = np.exp(raw - raw.max(axis=0, keepdims=True))
+            return e / e.sum(axis=0, keepdims=True)
+        if name == "multiclassova":
+            return 1.0 / (1.0 + np.exp(-self.config.sigmoid * raw))
+        if name == "poisson":
+            return np.exp(raw)
+        if name == "xentropy":
+            return 1.0 / (1.0 + np.exp(-raw))
+        if name == "xentlambda":
+            return np.log1p(np.exp(raw))
+        return raw
+
+    # -- evaluation ----------------------------------------------------------
+
+    def eval_valid(self):
+        return self._gbdt.eval_all()
+
+    # -- model io ------------------------------------------------------------
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None) -> "Booster":
+        from .io.model_text import save_model_file
+        save_model_file(self, filename, num_iteration)
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None) -> str:
+        from .io.model_text import model_to_string
+        return model_to_string(self, num_iteration)
+
+    def dump_model(self, num_iteration: Optional[int] = None) -> Dict:
+        from .io.model_json import dump_model_dict
+        return dump_model_dict(self, num_iteration)
+
+    # -- introspection -------------------------------------------------------
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        """split counts or total gains per feature (reference boosting.h:216)."""
+        imp = np.zeros(self.num_total_features, dtype=np.float64)
+        for t in self.trees:
+            for i in range(t.num_internal):
+                if importance_type == "split":
+                    imp[t.split_feature[i]] += 1
+                else:
+                    imp[t.split_feature[i]] += t.split_gain[i]
+        if importance_type == "split":
+            return imp.astype(np.int64)
+        return imp
+
+    def feature_name(self) -> List[str]:
+        return list(self.feature_names)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_gbdt", None)
+        state.pop("train_dataset", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._gbdt = None

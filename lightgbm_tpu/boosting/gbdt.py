@@ -1,0 +1,373 @@
+"""GBDT boosting driver — the reference's training loop, device-resident.
+
+Reference: src/boosting/gbdt.{cpp,h}. The per-iteration pipeline
+(gbdt.cpp:379-473) — Boosting() gradients -> Bagging -> per-class
+tree_learner->Train -> Shrinkage -> UpdateScore — is compiled into ONE jitted
+`step` whose tree growth runs a device-side while_loop (grower.py). The host
+loop only enqueues steps and fetches scores at eval points; on the axon
+tunnel a host sync costs ~67ms (exp/RESULTS.md), so nothing in the hot loop
+blocks.
+
+Semantics kept from the reference:
+- boost-from-average initial score folded into the first tree as a bias
+  (gbdt.cpp:357-377 + AddBias :445-447),
+- bagging re-sampled every `bagging_freq` iterations (gbdt.cpp:225-270;
+  mask-based Bernoulli instead of exact-count index partition — OOB rows are
+  excluded from histograms/counts but still routed so score updates stay
+  O(N) gathers),
+- per-tree feature_fraction sampling (serial_tree_learner.cpp:240-252),
+- training stops when no tree in an iteration could split
+  (gbdt.cpp:465-471), checked at sync points,
+- early stopping on validation metrics (gbdt.cpp:493-518).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import ConstructedDataset, Metadata
+from ..grower import GrowerSpec, TreeArrays, grow_tree
+from ..metrics import Metric, create_metrics
+from ..objectives import Objective, create_objective
+from ..ops.predict import leaves_from_binned
+from ..tree import Tree, tree_from_device_arrays
+from ..utils.log import Log
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+class ValidSet:
+    def __init__(self, name: str, Xb_dev: jnp.ndarray, metadata: Metadata,
+                 metrics: List[Metric], num_data: int):
+        self.name = name
+        self.Xb = Xb_dev
+        self.metadata = metadata
+        self.metrics = metrics
+        self.num_data = num_data
+        self.score: Optional[jnp.ndarray] = None
+
+    # duck-typed Dataset surface so user fevals written against the reference
+    # python-package contract (feval(preds, eval_data)) keep working
+    def get_label(self):
+        return self.metadata.label
+
+    def get_weight(self):
+        return self.metadata.weight
+
+    def get_group(self):
+        qb = self.metadata.query_boundaries
+        return None if qb is None else np.diff(qb)
+
+
+class GBDT:
+    """Boosting driver (reference class GBDT, src/boosting/gbdt.h:25)."""
+
+    average_output = False  # RF overrides (boosting.h average_output_)
+
+    def __init__(self, config: Config, train_set: ConstructedDataset,
+                 objective: Optional[Objective] = None):
+        self.config = config
+        self.train_set = train_set
+        self.objective = objective if objective is not None else create_objective(config)
+        if self.objective is not None:
+            self.objective.init(train_set.metadata, train_set.num_data)
+        self.num_models = self.objective.num_models if self.objective else max(config.num_class, 1)
+        K = self.num_models
+
+        N = train_set.num_data
+        F = train_set.num_features
+        chunk = min(config.tpu_hist_chunk, _round_up(max(N, 1), 256))
+        Npad = _round_up(max(N, 1), chunk)
+        self.num_data = N
+        self.num_data_padded = Npad
+
+        Xb = train_set.X_binned
+        self.Xb = jnp.asarray(np.pad(Xb, ((0, Npad - N), (0, 0))))
+        self.label = jnp.asarray(np.pad(train_set.metadata.label, (0, Npad - N)))
+        w = train_set.metadata.weight
+        self.weight = None if w is None else jnp.asarray(np.pad(w, (0, Npad - N)))
+        self.pad_mask = jnp.asarray(
+            (np.arange(Npad) < N).astype(np.float32))
+
+        meta = train_set.feature_meta_arrays()
+        self.num_bins = jnp.asarray(meta["num_bins"])
+        self.missing_code = jnp.asarray(meta["missing_code"])
+        self.default_bin = jnp.asarray(meta["default_bin"])
+        self.is_categorical_np = meta["is_categorical"]
+        # categorical split search lands in a later milestone: exclude those
+        # features from split search for now (they still bin + route fine).
+        self.feature_ok_base = jnp.asarray(~meta["is_categorical"])
+
+        num_leaves = config.max_leaves_by_depth
+        Bpad = max(8, _round_up(train_set.max_num_bin, 8))
+        slots = config.tpu_hist_slots or max(1, min(16, num_leaves - 1))
+        wave = config.tpu_wave_size or slots
+        self.spec = GrowerSpec(
+            num_leaves=num_leaves,
+            num_features=F,
+            num_bins_padded=Bpad,
+            chunk_rows=chunk,
+            hist_slots=slots,
+            wave_size=min(wave, slots),
+            max_depth=config.max_depth,
+            lambda_l1=config.lambda_l1,
+            lambda_l2=config.lambda_l2,
+            min_data_in_leaf=float(config.min_data_in_leaf),
+            min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
+            min_gain_to_split=config.min_gain_to_split,
+        )
+
+        # feature_fraction: number of features used per tree
+        n_usable = int(np.sum(~self.is_categorical_np))
+        self.n_feature_sample = max(1, int(round(config.feature_fraction * F)))
+        self.use_feature_fraction = config.feature_fraction < 1.0 and self.n_feature_sample < F
+
+        self.train_metrics = create_metrics(config, self.objective.name if self.objective else None)
+        for m in self.train_metrics:
+            m.init(train_set.metadata, N)
+        self.valid_sets: List[ValidSet] = []
+
+        # ---- initial scores -------------------------------------------------
+        self.init_score_value = 0.0
+        meta_is = train_set.metadata.init_score
+        has_init = meta_is is not None
+        if (config.boost_from_average and not has_init and K == 1
+                and self.objective is not None):
+            avg = self.objective.boost_from_average_score()
+            if avg is not None and abs(avg) > 1e-15:
+                self.init_score_value = float(avg)
+
+        base = np.full((K, Npad), self.init_score_value, dtype=np.float32)
+        if has_init:
+            is_arr = np.asarray(meta_is, dtype=np.float32).reshape(K, N, order="C") \
+                if len(meta_is) == K * N else np.tile(np.asarray(meta_is, np.float32), (K, 1))
+            base[:, :N] += is_arr
+        self.score = jnp.asarray(base)
+
+        self.models: List[List] = []        # per iteration: list of K device TreeArrays
+        self._num_leaves_dev: List = []     # per iteration: [K] device array
+        self.iter_ = 0
+        self.best_iter: Dict[str, int] = {}
+        self.best_score: Dict[str, float] = {}
+        self._rng_key = jax.random.PRNGKey(config.seed if config.seed else config.bagging_seed)
+
+        self.bagging_on = config.bagging_freq > 0 and config.bagging_fraction < 1.0
+        self.bag_mask = self.pad_mask
+        self.best_iteration = 0
+
+        self._step_fn = None
+        self._custom_step_fn = None
+
+    # ------------------------------------------------------------------ setup
+
+    def add_valid(self, name: str, binned: np.ndarray, metadata: Metadata) -> None:
+        nv = binned.shape[0]
+        metrics = create_metrics(self.config, self.objective.name if self.objective else None)
+        for m in metrics:
+            m.init(metadata, nv)
+        vs = ValidSet(name, jnp.asarray(binned), metadata, metrics, nv)
+        base = np.full((self.num_models, nv), self.init_score_value, dtype=np.float32)
+        if metadata.init_score is not None:
+            base += np.asarray(metadata.init_score, np.float32).reshape(
+                self.num_models, nv)
+        vs.score = jnp.asarray(base)
+        self.valid_sets.append(vs)
+
+    # ------------------------------------------------------------- train step
+
+    def _gradients(self, score):
+        """Hook: GOSS/DART/RF override pieces of this pipeline."""
+        label = self.label
+        g, h = self.objective.gradients(score, label, self.weight)
+        return g, h
+
+    def _bag_mask_for_iter(self, key, it, prev_mask):
+        if not self.bagging_on:
+            return self.pad_mask
+        resample = (it % self.config.bagging_freq) == 0
+        bern = jax.random.uniform(key, (self.num_data_padded,)) < self.config.bagging_fraction
+        new_mask = bern.astype(jnp.float32) * self.pad_mask
+        return jnp.where(resample, new_mask, prev_mask)
+
+    def _sampling(self, g, h, bag_mask, key, it):
+        """Row-sampling hook: returns (mask, g, h). Base = bagging; GOSS
+        overrides with gradient-based one-side sampling (goss.hpp:86-131)."""
+        mask = self._bag_mask_for_iter(key, it, bag_mask)
+        return mask, g, h
+
+    def _tree_output_transform(self, tree):
+        """Hook: RF converts leaf outputs via the objective (rf.hpp:160-167)."""
+        return tree
+
+    def _score_update(self, old_score_k, contrib, it):
+        """Hook: base adds; RF maintains a running average (rf.hpp:117-121)."""
+        return old_score_k + contrib
+
+    def _make_step(self, custom_grads: bool = False):
+        spec = self.spec
+        K = self.num_models
+
+        def step(score, valid_scores, bag_mask, key, it, shrinkage, *grads):
+            if custom_grads:
+                g, h = grads
+            else:
+                g, h = self._gradients(score)
+            bkey, fkey = jax.random.split(jax.random.fold_in(key, 0))
+            mask, g, h = self._sampling(g, h, bag_mask, bkey, it)
+            trees = []
+            nleaves = []
+            new_scores = []
+            new_valid = [list(vs) for vs in valid_scores] if valid_scores else []
+            for k in range(K):
+                if self.use_feature_fraction:
+                    fk = jax.random.fold_in(fkey, k)
+                    noise = jax.random.uniform(fk, (spec.num_features,))
+                    _, top_idx = jax.lax.top_k(noise, self.n_feature_sample)
+                    fmask = jnp.zeros(spec.num_features, bool).at[top_idx].set(True)
+                    fmask = fmask & self.feature_ok_base
+                else:
+                    fmask = self.feature_ok_base
+                tree, leaf_ids = grow_tree(
+                    self.Xb, g[k] * mask, h[k] * mask, mask, fmask,
+                    self.num_bins, self.missing_code, self.default_bin, spec)
+                tree = tree._replace(leaf_value=tree.leaf_value * shrinkage)
+                tree = self._tree_output_transform(tree)
+                new_scores.append(self._score_update(score[k], tree.leaf_value[leaf_ids], it))
+                for vi, vs in enumerate(self.valid_sets):
+                    vleaf = leaves_from_binned(tree, vs.Xb, self.num_bins,
+                                               self.missing_code, self.default_bin)
+                    new_valid[vi][k] = self._score_update(
+                        new_valid[vi][k], tree.leaf_value[vleaf], it)
+                trees.append(tree)
+                nleaves.append(tree.num_leaves)
+            out_score = jnp.stack(new_scores)
+            out_valid = tuple(tuple(v) for v in new_valid)
+            return out_score, out_valid, mask, tuple(trees), jnp.stack(nleaves)
+
+        return jax.jit(step)
+
+    def _run_step(self, score, shrinkage: float, custom_gh=None):
+        """Dispatch one compiled step against current state; returns new score
+        and per-valid score tuples (device)."""
+        if custom_gh is None:
+            if self._step_fn is None:
+                self._step_fn = self._make_step()
+            fn, extra = self._step_fn, ()
+        else:
+            if self._custom_step_fn is None:
+                self._custom_step_fn = self._make_step(custom_grads=True)
+            fn, extra = self._custom_step_fn, custom_gh
+        key = jax.random.fold_in(self._rng_key, self.iter_)
+        valid_scores = tuple(tuple(vs.score[k] for k in range(self.num_models))
+                             for vs in self.valid_sets)
+        score, out_valid, self.bag_mask, trees, nl = fn(
+            score, valid_scores, self.bag_mask, key,
+            jnp.asarray(self.iter_, jnp.int32),
+            jnp.asarray(shrinkage, jnp.float32), *extra)
+        self.models.append(list(trees))
+        self._num_leaves_dev.append(nl)
+        self.iter_ += 1
+        return score, out_valid
+
+    def train_one_iter(self) -> None:
+        score, out_valid = self._run_step(self.score, self.config.learning_rate)
+        self.score = score
+        for vi, vs in enumerate(self.valid_sets):
+            vs.score = jnp.stack(out_valid[vi])
+
+    # ---------------------------------------------------- custom objective
+
+    def train_one_iter_custom(self, fobj) -> None:
+        """One iteration with user-supplied gradients (reference
+        LGBM_BoosterUpdateOneIterCustom, c_api.cpp:892): fobj(preds, dataset)
+        -> (grad, hess) as numpy [K*N] in class-major order."""
+        K, Npad, N = self.num_models, self.num_data_padded, self.num_data
+        preds = np.asarray(self.score)[:, :N].reshape(-1)
+        grad, hess = fobj(preds, self.train_set)
+        g = np.zeros((K, Npad), np.float32)
+        h = np.zeros((K, Npad), np.float32)
+        g[:, :N] = np.asarray(grad, np.float32).reshape(K, N)
+        h[:, :N] = np.asarray(hess, np.float32).reshape(K, N)
+        score, out_valid = self._run_step(
+            self.score, self.config.learning_rate,
+            custom_gh=(jnp.asarray(g), jnp.asarray(h)))
+        self.score = score
+        for vi, vs in enumerate(self.valid_sets):
+            vs.score = jnp.stack(out_valid[vi])
+
+    def _check_no_splits(self) -> bool:
+        """Reference gbdt.cpp:465-471: pop the iteration and stop when no tree
+        could split."""
+        if not self._num_leaves_dev:
+            return False
+        nl = np.asarray(self._num_leaves_dev[-1])
+        if (nl <= 1).all():
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements.")
+            self.models.pop()
+            self._num_leaves_dev.pop()
+            self.iter_ -= 1
+            return True
+        return False
+
+    # ------------------------------------------------------------------- eval
+
+    def eval_all(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        if self.config.is_training_metric and self.train_metrics:
+            conv = np.asarray(self._convert(self.score))[:, : self.num_data]
+            for m in self.train_metrics:
+                for name, value, hib in m.eval(conv):
+                    out.append(("training", name, value, hib))
+        for vs in self.valid_sets:
+            conv = np.asarray(self._convert(vs.score))
+            for m in vs.metrics:
+                for name, value, hib in m.eval(conv):
+                    out.append((vs.name, name, value, hib))
+        return out
+
+    def _convert(self, score):
+        if self.objective is None or self.average_output:
+            # RF scores are already averages of converted outputs (rf.hpp)
+            return score
+        return self.objective.convert_output(score)
+
+    # ------------------------------------------------------------------ model
+
+    def finalize_model(self) -> List[List[Tree]]:
+        """Fetch device trees to host Tree objects (one transfer), fold the
+        boost-from-average bias into the first tree (gbdt.cpp:445-447)."""
+        host = jax.device_get(self.models)
+        mappers = self.train_set.mappers
+        rfi = self.train_set.real_feature_idx
+        forest: List[List[Tree]] = []
+        for it_trees in host:
+            forest.append([tree_from_device_arrays(t, mappers, rfi) for t in it_trees])
+        if forest and abs(self.init_score_value) > 1e-15:
+            for k in range(self.num_models):
+                forest[0][k].add_bias(self.init_score_value)
+        return forest
+
+
+def create_boosting(config: Config, train_set: ConstructedDataset) -> GBDT:
+    """Factory (reference: boosting.cpp:42-66)."""
+    btype = config.boosting_normalized
+    if btype == "gbdt":
+        return GBDT(config, train_set)
+    if btype == "goss":
+        from .goss import GOSS
+        return GOSS(config, train_set)
+    if btype == "dart":
+        from .dart import DART
+        return DART(config, train_set)
+    if btype == "rf":
+        from .rf import RF
+        return RF(config, train_set)
+    Log.fatal("Unknown boosting type %s", config.boosting_type)

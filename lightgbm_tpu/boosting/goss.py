@@ -1,0 +1,50 @@
+"""GOSS: Gradient-based One-Side Sampling (reference: src/boosting/goss.hpp).
+
+Keeps the top `top_rate` fraction of rows by sum-over-classes |grad*hess|
+(goss.hpp:88-98), Bernoulli-samples `other_rate` of the rest and up-weights
+their gradients/hessians by (1-top_rate)/other_rate-style multiplier
+(goss.hpp:100-126). Sampling starts only after 1/learning_rate iterations
+(goss.hpp:134-137). Mask-based: selected-out rows get weight 0 instead of
+being compacted out of an index array.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..utils.log import Log
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    def __init__(self, config: Config, train_set, objective=None):
+        super().__init__(config, train_set, objective)
+        if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
+            Log.fatal("Cannot use bagging in GOSS")
+        Log.info("Using GOSS")
+        self.bagging_on = False
+
+    def _sampling(self, g, h, bag_mask, key, it):
+        cfg = self.config
+        N = self.num_data
+        top_k = max(1, int(N * cfg.top_rate))
+        other_k = max(1, int(N * cfg.other_rate))
+        warmup = int(1.0 / cfg.learning_rate)
+
+        weights = jnp.sum(jnp.abs(g * h), axis=0) * self.pad_mask  # [Npad]
+        thr = jax.lax.top_k(weights, top_k)[0][-1]
+        is_top = (weights >= thr) & (self.pad_mask > 0)
+        rest = (~is_top) & (self.pad_mask > 0)
+        prob = other_k / max(N - top_k, 1)
+        sel_other = rest & (jax.random.uniform(key, weights.shape) < prob)
+        multiply = (N - top_k) / other_k
+
+        goss_mask = (is_top | sel_other).astype(jnp.float32)
+        scale = jnp.where(sel_other, multiply, 1.0)[None, :]
+
+        use_goss = it >= warmup
+        mask = jnp.where(use_goss, goss_mask, self.pad_mask)
+        g = jnp.where(use_goss, g * scale, g)
+        h = jnp.where(use_goss, h * scale, h)
+        return mask, g, h

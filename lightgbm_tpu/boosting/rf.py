@@ -1,0 +1,46 @@
+"""Random Forest mode (reference: src/boosting/rf.hpp).
+
+Bagging is mandatory; gradients are always computed at zero scores so the
+trees are independent (rf.hpp:97-104); each tree's leaf outputs go through the
+objective's ConvertOutput (rf.hpp:160-167); the maintained score is the
+running average of converted tree outputs (rf.hpp:117-121), and prediction
+averages tree outputs without a final transform (average_output).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..config import Config
+from ..utils.log import Log
+from .gbdt import GBDT
+
+
+class RF(GBDT):
+    average_output = True
+
+    def __init__(self, config: Config, train_set, objective=None):
+        super().__init__(config, train_set, objective)
+        if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0):
+            Log.fatal("RF mode requires 0 < bagging_fraction < 1 and bagging_freq > 0")
+        if self.num_models != 1:
+            Log.fatal("Cannot use RF for multi-class (rf.hpp:42)")
+        Log.info("Using random forest")
+
+    def _gradients(self, score):
+        # trees are independent: gradients at zero score (rf.hpp:97-104)
+        return self.objective.gradients(jnp.zeros_like(score), self.label, self.weight)
+
+    def _tree_output_transform(self, tree):
+        return tree._replace(
+            leaf_value=self.objective.convert_output(tree.leaf_value))
+
+    def _score_update(self, old_score_k, contrib, it):
+        itf = it.astype(jnp.float32)
+        return (old_score_k * itf + contrib) / (itf + 1.0)
+
+    def train_one_iter(self) -> None:
+        # shrinkage is 1 for RF (rf.hpp:44-45)
+        score, out_valid = self._run_step(self.score, 1.0)
+        self.score = score
+        for vi, vs in enumerate(self.valid_sets):
+            vs.score = jnp.stack(out_valid[vi])

@@ -1,0 +1,423 @@
+"""Parameter/config system.
+
+Mirrors the reference's string-map driven config pipeline so that LightGBM
+parameter dicts and `train.conf` files work unchanged:
+
+- full parameter surface of LightGBM v2.0.10 with identical defaults
+  (reference: include/LightGBM/config.h:94-300),
+- alias resolution with the same priority rule — longest name wins, ties
+  alphabetical (reference: include/LightGBM/config.h:358-514),
+- conf-file parsing `key = value` with `#` comments
+  (reference: src/application/application.cpp:48-81),
+- conflict checks (reference: src/io/config.cpp OverallConfig::CheckParamConflict).
+
+TPU additions: ``device=tpu`` (the default) joins ``cpu``/``gpu``;
+``tree_learner`` gains no new values — serial/feature/data/voting map onto a
+`jax.sharding.Mesh` instead of sockets/MPI.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+from .utils.log import Log
+
+# Alias -> canonical parameter name (reference: config.h:360-445).
+PARAMETER_ALIASES: Dict[str, str] = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "random_seed": "seed",
+    "num_thread": "num_threads",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "enable_sparse": "is_enable_sparse",
+    "pre_partition": "is_pre_partition",
+    "training_metric": "is_training_metric",
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "eval_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "categorical_feature": "categorical_column",
+    "cat_column": "categorical_column",
+    "cat_feature": "categorical_column",
+    "predict_raw_score": "is_predict_raw_score",
+    "predict_leaf_index": "is_predict_leaf_index",
+    "raw_score": "is_predict_raw_score",
+    "leaf_index": "is_predict_leaf_index",
+    "contrib": "is_predict_contrib",
+    "predict_contrib": "is_predict_contrib",
+    "min_split_gain": "min_gain_to_split",
+    "topk": "top_k",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "num_classes": "num_class",
+    "unbalanced_sets": "is_unbalance",
+    "bagging_fraction_seed": "bagging_seed",
+    "workers": "machines",
+    "nodes": "machines",
+}
+
+# Historical misspelling kept by the reference (config.h:466 "poission_...").
+PARAMETER_ALIASES["poission_max_delta_step"] = "poisson_max_delta_step"
+# Reference accepts both spellings of the machine list file param.
+PARAMETER_ALIASES["machine_list_filename"] = "machine_list_file"
+PARAMETER_ALIASES["data_filename"] = "data"
+PARAMETER_ALIASES["valid_data_filenames"] = "valid_data"
+
+
+def _parse_bool(value: Any, name: str) -> bool:
+    if isinstance(value, bool):
+        return value
+    v = str(value).lower()
+    if v in ("false", "-", "0"):
+        return False
+    if v in ("true", "+", "1"):
+        return True
+    Log.fatal('Parameter %s should be "true"/"+" or "false"/"-", got "%s"', name, value)
+
+
+def _parse_int_list(value: Any) -> List[int]:
+    if isinstance(value, (list, tuple)):
+        return [int(v) for v in value]
+    return [int(v) for v in str(value).split(",") if v != ""]
+
+
+def _parse_float_list(value: Any) -> List[float]:
+    if isinstance(value, (list, tuple)):
+        return [float(v) for v in value]
+    return [float(v) for v in str(value).split(",") if v != ""]
+
+
+def _parse_str_list(value: Any) -> List[str]:
+    if isinstance(value, (list, tuple)):
+        return [str(v) for v in value]
+    return [v for v in str(value).split(",") if v != ""]
+
+
+@dataclass
+class Config:
+    """Flat config holding the whole reference parameter surface.
+
+    Defaults match include/LightGBM/config.h:94-300 exactly; the grouping into
+    IO/Objective/Metric/Tree/Boosting/Network structs is collapsed — every
+    consumer reads the fields it needs (the reference nests copies of e.g.
+    num_class into four structs; one field here).
+    """
+
+    # --- task / device -----------------------------------------------------
+    task: str = "train"                       # train | predict | convert_model | refit
+    device: str = "tpu"                       # tpu (native) | cpu | gpu (aliases for tpu)
+    seed: int = 0
+    num_threads: int = 0
+    verbose: int = 1
+
+    # --- IO (config.h:94-160) ---------------------------------------------
+    max_bin: int = 255
+    num_class: int = 1
+    data_random_seed: int = 1
+    data: str = ""
+    valid_data: List[str] = field(default_factory=list)
+    init_score_file: str = ""
+    valid_init_score_file: List[str] = field(default_factory=list)
+    snapshot_freq: int = -1
+    output_model: str = "LightGBM_model.txt"
+    output_result: str = "LightGBM_predict_result.txt"
+    convert_model: str = "gbdt_prediction.cpp"
+    convert_model_language: str = ""
+    input_model: str = ""
+    model_format: str = "text"                # text | proto (fork addition: proto/model.proto)
+    num_iteration_predict: int = -1
+    is_pre_partition: bool = False
+    is_enable_sparse: bool = True
+    sparse_threshold: float = 0.8
+    use_two_round_loading: bool = False
+    is_save_binary_file: bool = False
+    enable_load_from_binary_file: bool = True
+    bin_construct_sample_cnt: int = 200000
+    is_predict_leaf_index: bool = False
+    is_predict_contrib: bool = False
+    is_predict_raw_score: bool = False
+    min_data_in_bin: int = 3
+    max_conflict_rate: float = 0.0
+    enable_bundle: bool = True
+    has_header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_column: str = ""
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+    zero_as_missing: bool = False
+    use_missing: bool = True
+
+    # --- objective (config.h:163-184) --------------------------------------
+    objective: str = "regression"
+    sigmoid: float = 1.0
+    huber_delta: float = 1.0
+    fair_c: float = 1.0
+    gaussian_eta: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    label_gain: List[float] = field(default_factory=list)
+    max_position: int = 20
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+
+    # --- metric (config.h:187-196) ------------------------------------------
+    metric: List[str] = field(default_factory=list)
+    metric_freq: int = 1
+    is_training_metric: bool = False
+    ndcg_eval_at: List[int] = field(default_factory=lambda: [1, 2, 3, 4, 5])
+
+    # --- tree (config.h:200-233) --------------------------------------------
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    num_leaves: int = 31
+    feature_fraction_seed: int = 2
+    feature_fraction: float = 1.0
+    histogram_pool_size: float = -1.0
+    max_depth: int = -1
+    top_k: int = 20
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+    min_data_per_group: int = 100
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+
+    # --- boosting (config.h:236-260) ----------------------------------------
+    boosting_type: str = "gbdt"               # gbdt | dart | goss | rf
+    output_freq: int = 1
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    bagging_fraction: float = 1.0
+    bagging_seed: int = 3
+    bagging_freq: int = 0
+    early_stopping_round: int = 0
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    boost_from_average: bool = True
+    tree_learner: str = "serial"              # serial | feature | data | voting
+
+    # --- network (config.h:264-272) — mapped onto jax.distributed -----------
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_file: str = ""
+    machines: str = ""
+
+    # --- TPU-specific knobs (no reference equivalent) -----------------------
+    # leaf splits applied per device-side wave; 0 = auto (frontier-wide,
+    # leaf-wise order preserved near the leaf budget), 1 = exact LightGBM
+    # one-leaf-at-a-time growth.
+    tpu_wave_size: int = 0
+    # row-chunk length for the histogram one-hot matmul pass
+    tpu_hist_chunk: int = 32768
+    # accumulate g/h as bf16 hi+lo pairs (~f32 precision) vs plain bf16
+    tpu_hist_hilo: bool = True
+    # number of leaf slots whose histograms are built in one pass
+    tpu_hist_slots: int = 0                   # 0 = auto
+
+    def __post_init__(self):
+        self._check()
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]] = None, **kwargs) -> "Config":
+        """Build a Config from a LightGBM-style parameter dict (aliases ok)."""
+        merged = dict(params or {})
+        merged.update(kwargs)
+        resolved = resolve_aliases(merged)
+        return cls(**_coerce_fields(resolved))
+
+    @classmethod
+    def from_conf_file(cls, path: str, overrides: Optional[Dict[str, Any]] = None) -> "Config":
+        """Parse a reference-style `train.conf` (application.cpp:48-81)."""
+        params = parse_conf_file(path)
+        params.update(overrides or {})
+        return cls.from_params(params)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def replace(self, **kwargs) -> "Config":
+        resolved = resolve_aliases(kwargs)
+        return dataclasses.replace(self, **_coerce_fields(resolved))
+
+    # -- validation ----------------------------------------------------------
+
+    def _check(self) -> None:
+        """Parameter conflict checks (reference: OverallConfig::CheckParamConflict)."""
+        if self.num_leaves < 2:
+            Log.fatal("num_leaves must be >= 2, got %d", self.num_leaves)
+        if self.max_bin < 2:
+            Log.fatal("max_bin must be >= 2, got %d", self.max_bin)
+        if not 0.0 < self.feature_fraction <= 1.0:
+            Log.fatal("feature_fraction must be in (0, 1], got %g", self.feature_fraction)
+        if not 0.0 < self.bagging_fraction <= 1.0:
+            Log.fatal("bagging_fraction must be in (0, 1], got %g", self.bagging_fraction)
+        if self.boosting_type not in ("gbdt", "gbrt", "dart", "goss", "rf", "random_forest"):
+            Log.fatal("Unknown boosting type %s", self.boosting_type)
+        if self.tree_learner not in ("serial", "feature", "data", "voting"):
+            Log.fatal("Unknown tree learner type %s", self.tree_learner)
+        if self.boosting_type in ("rf", "random_forest"):
+            # reference: rf.hpp:18-29 — bagging is mandatory for random forest
+            if not (self.bagging_freq > 0 and self.bagging_fraction < 1.0):
+                Log.fatal("Random forest needs bagging_freq > 0 and bagging_fraction < 1.0")
+        if self.objective in ("multiclass", "multiclassova", "softmax", "ova") and self.num_class <= 1:
+            Log.fatal("Number of classes should be > 1 for multiclass training")
+        if self.top_rate + self.other_rate > 1.0:
+            Log.fatal("top_rate + other_rate cannot be larger than 1.0 for GOSS")
+
+    # -- derived -------------------------------------------------------------
+
+    @property
+    def max_leaves_by_depth(self) -> int:
+        """max_depth caps leaves at 2**max_depth (config.h:216-219)."""
+        if self.max_depth > 0:
+            return min(self.num_leaves, 2 ** self.max_depth)
+        return self.num_leaves
+
+    @property
+    def boosting_normalized(self) -> str:
+        return {"gbrt": "gbdt", "random_forest": "rf"}.get(self.boosting_type, self.boosting_type)
+
+
+_FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(Config)}
+_LIST_INT_FIELDS = {"ndcg_eval_at"}
+_LIST_FLOAT_FIELDS = {"label_gain"}
+_LIST_STR_FIELDS = {"valid_data", "valid_init_score_file", "metric"}
+_KNOWN_DROPPED = {"config_file", "machine_list_filename"}  # handled out-of-band
+
+
+def resolve_aliases(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Apply the alias table with the reference's priority rule.
+
+    When multiple aliases of one parameter appear, the one with the longest
+    name wins; ties break alphabetically (config.h:479-513). A canonical name
+    always beats its aliases.
+    """
+    out: Dict[str, Any] = {}
+    alias_source: Dict[str, str] = {}
+    canonical_names = set(_FIELD_TYPES)
+    for key, value in params.items():
+        canon = PARAMETER_ALIASES.get(key)
+        if canon is None:
+            if key in canonical_names:
+                out[key] = value
+            elif key in _KNOWN_DROPPED:
+                continue
+            else:
+                Log.warning("Unknown parameter: %s", key)
+            continue
+        prev = alias_source.get(canon)
+        if prev is None or (len(key), key) > (len(prev), prev):
+            alias_source[canon] = key
+            if canon not in params:  # canonical name in input always wins
+                out[canon] = value
+        if prev is not None:
+            Log.warning("%s is set by aliases %s and %s; using %s", canon, prev, key,
+                        alias_source[canon])
+    return out
+
+
+def _coerce_fields(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Coerce string values (from conf files / CLI) to field types."""
+    out: Dict[str, Any] = {}
+    for name, value in params.items():
+        if name in _LIST_INT_FIELDS:
+            out[name] = _parse_int_list(value)
+        elif name in _LIST_FLOAT_FIELDS:
+            out[name] = _parse_float_list(value)
+        elif name in _LIST_STR_FIELDS:
+            out[name] = _parse_str_list(value)
+        else:
+            ftype = str(_FIELD_TYPES.get(name, "str"))
+            if "bool" in ftype:
+                out[name] = _parse_bool(value, name)
+            elif "int" in ftype:
+                out[name] = int(float(value)) if not isinstance(value, int) else value
+            elif "float" in ftype:
+                out[name] = float(value)
+            else:
+                out[name] = str(value)
+    return out
+
+
+def parse_conf_file(path: str) -> Dict[str, str]:
+    """Parse `key = value` lines, `#` comments (application.cpp:60-77)."""
+    params: Dict[str, str] = {}
+    with open(path, "r") as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            key, value = line.split("=", 1)
+            params[key.strip()] = value.strip()
+    return params

@@ -1,0 +1,290 @@
+"""Binned Dataset: the training matrix as a dense device-resident bin matrix.
+
+Reference counterpart: include/LightGBM/dataset.h:280 (Dataset),
+dataset.h:36-248 (Metadata), src/io/dataset_loader.cpp (construction flow).
+
+TPU-first inversion of the reference design: instead of per-feature-group
+Bin objects with sparse/dense/4-bit variants and leaf-ordered copies
+(src/io/dense_bin.hpp, sparse_bin.hpp, ordered_sparse_bin.hpp), the whole
+dataset is ONE dense `uint8/uint16 [num_data, num_features]` array in HBM.
+Sparsity is irrelevant to the MXU histogram kernel (a zero bin costs the same
+as any bin), so the sparse/dense split and `sparse_threshold` become no-ops
+kept only for config compatibility. Per-feature bin counts stay variable;
+`bin_offsets` flattens (feature, bin) into one axis for split scans.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN, MISSING_NONE,
+                      MISSING_ZERO, BinMapper, sample_for_binning)
+from .config import Config
+from .utils.log import Log
+
+
+class Metadata:
+    """Labels / weights / query boundaries / init scores
+    (reference: dataset.h:36-248, src/io/metadata.cpp)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = num_data
+        self.label = np.zeros(num_data, dtype=np.float32)
+        self.weight: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None
+        self.query_weights: Optional[np.ndarray] = None
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label: Sequence[float]) -> None:
+        label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if len(label) != self.num_data:
+            Log.fatal("Length of label (%d) != num_data (%d)", len(label), self.num_data)
+        self.label = label
+
+    def set_weight(self, weight: Optional[Sequence[float]]) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if len(weight) != self.num_data:
+            Log.fatal("Length of weight (%d) != num_data (%d)", len(weight), self.num_data)
+        self.weight = weight
+
+    def set_group(self, group: Optional[Sequence[int]]) -> None:
+        """`group` is per-query sizes (python API) -> boundaries
+        (reference: metadata.cpp SetQuery)."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).reshape(-1)
+        boundaries = np.concatenate([[0], np.cumsum(group)])
+        if boundaries[-1] != self.num_data:
+            Log.fatal("Sum of query counts (%d) != num_data (%d)", boundaries[-1], self.num_data)
+        self.query_boundaries = boundaries.astype(np.int32)
+
+    def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64).reshape(-1)
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+@dataclass
+class FeatureInfo:
+    """Construction-time info for one used (non-trivial) feature."""
+    real_index: int            # column in the raw input
+    mapper: BinMapper
+
+
+class ConstructedDataset:
+    """The binned dataset (reference Dataset, dataset.h:280).
+
+    Attributes
+    ----------
+    X_binned : np.ndarray [num_data, num_features] uint8|uint16
+        per-feature bin codes of the used (non-trivial) features.
+    mappers : list[BinMapper], one per used feature.
+    real_feature_idx : used feature -> raw column index
+        (reference: dataset.h:552 real_feature_idx_).
+    used_feature_map : raw column -> used feature index or -1
+        (reference: dataset.h:543 used_feature_map_).
+    bin_offsets : int32 [num_features+1]
+        flattened (feature, bin) offsets; total_bins = bin_offsets[-1].
+    """
+
+    def __init__(self, X_binned: np.ndarray, features: List[FeatureInfo],
+                 num_total_features: int, metadata: Metadata,
+                 feature_names: List[str], config: Config):
+        self.X_binned = X_binned
+        self.mappers = [f.mapper for f in features]
+        self.real_feature_idx = np.array([f.real_index for f in features], dtype=np.int32)
+        self.used_feature_map = np.full(num_total_features, -1, dtype=np.int32)
+        for inner, f in enumerate(features):
+            self.used_feature_map[f.real_index] = inner
+        self.num_total_features = num_total_features
+        self.metadata = metadata
+        self.feature_names = feature_names
+        self.config = config
+        counts = np.array([m.num_bin for m in self.mappers], dtype=np.int64)
+        self.bin_offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+        self.num_bins_per_feature = counts.astype(np.int32)
+
+    # -- shape ----------------------------------------------------------------
+
+    @property
+    def num_data(self) -> int:
+        return self.X_binned.shape[0]
+
+    @property
+    def num_features(self) -> int:
+        return self.X_binned.shape[1]
+
+    @property
+    def total_bins(self) -> int:
+        return int(self.bin_offsets[-1])
+
+    @property
+    def max_num_bin(self) -> int:
+        return int(self.num_bins_per_feature.max()) if self.num_features else 1
+
+    # -- feature metadata for the split kernels -------------------------------
+
+    def feature_meta_arrays(self) -> Dict[str, np.ndarray]:
+        """Static per-feature arrays consumed by the split-finding kernel."""
+        F = self.num_features
+        is_categorical = np.array(
+            [m.bin_type == BIN_CATEGORICAL for m in self.mappers], dtype=bool)
+        missing_code = np.array(
+            [{MISSING_NONE: 0, MISSING_ZERO: 1, MISSING_NAN: 2}[m.missing_type]
+             for m in self.mappers], dtype=np.int32)
+        default_bin = np.array([m.default_bin for m in self.mappers], dtype=np.int32)
+        return {
+            "is_categorical": is_categorical,
+            "missing_code": missing_code,
+            "default_bin": default_bin,
+            "num_bins": self.num_bins_per_feature,
+            "bin_offsets": self.bin_offsets,
+        }
+
+    # -- alignment (valid sets share the train mappers) -----------------------
+
+    def bin_raw(self, data: np.ndarray) -> np.ndarray:
+        """Bin a raw feature matrix with THIS dataset's mappers (the analog of
+        LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:221)."""
+        data = np.asarray(data)
+        out = np.zeros((data.shape[0], self.num_features), dtype=self.X_binned.dtype)
+        for inner, real in enumerate(self.real_feature_idx):
+            out[:, inner] = self.mappers[inner].value_to_bin(data[:, real])
+        return out
+
+    # -- binary serialization (reference: Dataset::SaveBinaryFile,
+    #    dataset.cpp:496; auto-detect load, dataset_loader.cpp:265) ----------
+
+    def save_binary(self, path: str) -> None:
+        import pickle
+        with open(path, "wb") as fh:
+            pickle.dump({
+                "format": "lightgbm_tpu.dataset.v1",
+                "X_binned": self.X_binned,
+                "mappers": self.mappers,
+                "real_feature_idx": self.real_feature_idx,
+                "num_total_features": self.num_total_features,
+                "feature_names": self.feature_names,
+                "label": self.metadata.label,
+                "weight": self.metadata.weight,
+                "query_boundaries": self.metadata.query_boundaries,
+                "init_score": self.metadata.init_score,
+                "config": self.config.to_dict(),
+            }, fh, protocol=pickle.HIGHEST_PROTOCOL)
+
+    @classmethod
+    def load_binary(cls, path: str) -> "ConstructedDataset":
+        import pickle
+        with open(path, "rb") as fh:
+            blob = pickle.load(fh)
+        if blob.get("format") != "lightgbm_tpu.dataset.v1":
+            Log.fatal("Not a lightgbm_tpu binary dataset file: %s", path)
+        meta = Metadata(blob["X_binned"].shape[0])
+        meta.set_label(blob["label"])
+        meta.set_weight(blob["weight"])
+        meta.query_boundaries = blob["query_boundaries"]
+        meta.init_score = blob["init_score"]
+        features = [FeatureInfo(int(r), m)
+                    for r, m in zip(blob["real_feature_idx"], blob["mappers"])]
+        ds = cls(blob["X_binned"], features, blob["num_total_features"], meta,
+                 blob["feature_names"], Config.from_params(blob["config"]))
+        return ds
+
+
+def _parse_column_spec(spec: str, feature_names: List[str]) -> List[int]:
+    """Parse 'name:a,name:b' or '0,1,2' column specs
+    (reference: dataset_loader.cpp column resolution)."""
+    if not spec:
+        return []
+    out = []
+    for tok in str(spec).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok.startswith("name:"):
+            name = tok[5:]
+            if name not in feature_names:
+                Log.fatal("Column name %s not found", name)
+            out.append(feature_names.index(name))
+        else:
+            out.append(int(tok))
+    return out
+
+
+def construct_dataset(
+    data: np.ndarray,
+    label: Optional[Sequence[float]],
+    config: Config,
+    weight: Optional[Sequence[float]] = None,
+    group: Optional[Sequence[int]] = None,
+    init_score: Optional[Sequence[float]] = None,
+    feature_names: Optional[List[str]] = None,
+    categorical_features: Optional[Sequence[Union[int, str]]] = None,
+) -> ConstructedDataset:
+    """Build a ConstructedDataset from a raw numpy matrix.
+
+    Mirrors DatasetLoader::ConstructBinMappersFromTextData
+    (dataset_loader.cpp:748-903): sample -> FindBin per feature -> drop
+    trivial features -> materialize bin codes.
+    """
+    data = np.ascontiguousarray(data)
+    if data.ndim != 2:
+        Log.fatal("Training data must be 2-dimensional")
+    num_data, num_total_features = data.shape
+    if feature_names is None:
+        feature_names = [f"Column_{i}" for i in range(num_total_features)]
+
+    # resolve categorical / ignored columns
+    cat_set = set()
+    if categorical_features is not None:
+        for c in categorical_features:
+            cat_set.add(feature_names.index(c) if isinstance(c, str) else int(c))
+    cat_set.update(_parse_column_spec(config.categorical_column, feature_names))
+    ignore_set = set(_parse_column_spec(config.ignore_column, feature_names))
+
+    # sampling (dataset_loader.cpp:688-746)
+    _, per_feature_samples = sample_for_binning(
+        data, config.bin_construct_sample_cnt, config.data_random_seed)
+    total_sample_cnt = min(num_data, config.bin_construct_sample_cnt)
+    # reference: filter_cnt = min_data_in_leaf * sample / num_data (dataset_loader.cpp:495)
+    filter_cnt = int(config.min_data_in_leaf * total_sample_cnt / max(num_data, 1))
+
+    features: List[FeatureInfo] = []
+    for j in range(num_total_features):
+        if j in ignore_set:
+            continue
+        mapper = BinMapper()
+        bin_type = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
+        mapper.find_bin(per_feature_samples[j], total_sample_cnt, config.max_bin,
+                        config.min_data_in_bin, filter_cnt, bin_type,
+                        config.use_missing, config.zero_as_missing)
+        if not mapper.is_trivial:
+            features.append(FeatureInfo(j, mapper))
+    if not features:
+        Log.warning("There are no meaningful features, as all feature values are constant.")
+
+    dtype = np.uint8 if all(f.mapper.num_bin <= 256 for f in features) else np.uint16
+    X_binned = np.zeros((num_data, max(len(features), 1)), dtype=dtype)
+    for inner, f in enumerate(features):
+        X_binned[:, inner] = f.mapper.value_to_bin(data[:, f.real_index]).astype(dtype)
+
+    metadata = Metadata(num_data)
+    if label is not None:
+        metadata.set_label(label)
+    metadata.set_weight(weight)
+    metadata.set_group(group)
+    metadata.set_init_score(init_score)
+
+    return ConstructedDataset(X_binned, features, num_total_features, metadata,
+                              feature_names, config)

@@ -1,0 +1,182 @@
+"""train() / cv() entry points (reference: python-package/lightgbm/engine.py:18,310)."""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .callback import CallbackEnv, EarlyStopException
+from .config import Config
+from .utils.log import Log
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj: Optional[Callable] = None, feval: Optional[Callable] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          feature_name: Union[str, List[str]] = "auto",
+          categorical_feature: Union[str, List] = "auto",
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[Dict] = None,
+          verbose_eval: Union[bool, int] = True,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """Mirror of reference engine.py:18 lgb.train."""
+    params = dict(params or {})
+    if "num_iterations" not in params and "num_boost_round" not in params:
+        params["num_iterations"] = num_boost_round
+    if early_stopping_rounds is not None:
+        params["early_stopping_round"] = early_stopping_rounds
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    booster = Booster(params=params, train_set=train_set)
+    config = booster.config
+    n_rounds = config.num_iterations
+
+    valid_sets = valid_sets or []
+    names = []
+    for i, vs in enumerate(valid_sets):
+        name = valid_names[i] if valid_names else f"valid_{i}"
+        if vs is train_set:
+            booster._gbdt.config = booster._gbdt.config.replace(is_training_metric=True)
+            names.append("training")
+            continue
+        if vs.reference is None:
+            vs.reference = train_set
+        booster.add_valid(vs, name)
+        names.append(name)
+
+    callbacks = list(callbacks or [])
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        if not booster._gbdt.valid_sets:
+            Log.fatal("For early stopping, at least one validation dataset is required")
+        from .callback import early_stopping
+        callbacks.append(early_stopping(early_stopping_rounds))
+    if isinstance(verbose_eval, bool):
+        if verbose_eval:
+            from .callback import log_evaluation
+            callbacks.append(log_evaluation(1))
+    elif isinstance(verbose_eval, int) and verbose_eval > 0:
+        from .callback import log_evaluation
+        callbacks.append(log_evaluation(verbose_eval))
+    if evals_result is not None:
+        from .callback import record_evaluation
+        callbacks.append(record_evaluation(evals_result))
+    callbacks_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    gbdt = booster._gbdt
+    eval_needed = bool(gbdt.valid_sets) or gbdt.config.is_training_metric or callbacks_after
+    best_iteration = 0
+    try:
+        for it in range(n_rounds):
+            for cb in callbacks_before:
+                cb(CallbackEnv(booster, params, it, 0, n_rounds, None))
+            if fobj is not None:
+                gbdt.train_one_iter_custom(fobj)
+            else:
+                gbdt.train_one_iter()
+            eval_results = []
+            if gbdt.valid_sets or gbdt.config.is_training_metric:
+                if (it + 1) % max(config.metric_freq, 1) == 0:
+                    eval_results = gbdt.eval_all()
+                    if feval is not None:
+                        eval_results.extend(_run_feval(feval, gbdt, booster))
+                    if gbdt._check_no_splits():
+                        break
+            for cb in callbacks_after:
+                cb(CallbackEnv(booster, params, it, 0, n_rounds, eval_results))
+    except EarlyStopException as e:
+        best_iteration = e.best_iteration + 1
+        booster.best_score = e.best_score
+
+    booster._finalize()
+    if best_iteration:
+        booster.best_iteration = best_iteration
+    return booster
+
+
+def _run_feval(feval, gbdt, booster):
+    out = []
+    import numpy as np
+    for vs in gbdt.valid_sets:
+        preds = np.asarray(gbdt._convert(vs.score)).reshape(-1)
+        res = feval(preds, vs)
+        if isinstance(res, tuple):
+            res = [res]
+        for name, value, hib in res:
+            out.append((vs.name, name, value, hib))
+    return out
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds: Optional[int] = None, fpreproc=None,
+       verbose_eval=None, show_stdv: bool = True, seed: int = 0,
+       callbacks=None) -> Dict[str, List[float]]:
+    """K-fold cross-validation (reference engine.py:310)."""
+    params = dict(params or {})
+    if early_stopping_rounds:
+        params["early_stopping_round"] = early_stopping_rounds
+    if metrics:
+        params["metric"] = metrics
+    train_set.construct(Config.from_params(train_set.params | params
+                                           if isinstance(train_set.params, dict) else params))
+    n = train_set.num_data()
+    label = train_set.get_label()
+    rng = np.random.default_rng(seed)
+
+    if folds is None:
+        idx = np.arange(n)
+        if stratified and label is not None and len(np.unique(label)) <= max(32, int(params.get("num_class", 2))):
+            folds_idx = [[] for _ in range(nfold)]
+            for cls in np.unique(label):
+                cls_idx = idx[label == cls]
+                if shuffle:
+                    rng.shuffle(cls_idx)
+                for f in range(nfold):
+                    folds_idx[f].extend(cls_idx[f::nfold])
+            folds = [(np.setdiff1d(idx, np.array(te)), np.array(sorted(te)))
+                     for te in folds_idx]
+        else:
+            if shuffle:
+                rng.shuffle(idx)
+            chunks = np.array_split(idx, nfold)
+            folds = [(np.concatenate([c for j, c in enumerate(chunks) if j != f]),
+                      chunks[f]) for f in range(nfold)]
+
+    results: Dict[str, List[float]] = collections.defaultdict(list)
+    fold_records = []
+    for tr_idx, te_idx in folds:
+        tr = train_set.subset(tr_idx, params=dict(train_set.params))
+        te_raw = train_set.raw_data[te_idx]
+        te_label = None if label is None else label[te_idx]
+        te = Dataset(te_raw, label=te_label, reference=tr)
+        evals_result: Dict = {}
+        train(params, tr, num_boost_round=num_boost_round, valid_sets=[te],
+              valid_names=["valid"], fobj=fobj, feval=feval,
+              early_stopping_rounds=early_stopping_rounds,
+              evals_result=evals_result, verbose_eval=False,
+              callbacks=callbacks)
+        fold_records.append(evals_result.get("valid", {}))
+
+    if fold_records:
+        for metric in fold_records[0]:
+            lengths = [len(fr[metric]) for fr in fold_records if metric in fr]
+            for i in range(min(lengths)):
+                vals = [fr[metric][i] for fr in fold_records]
+                results[f"{metric}-mean"].append(float(np.mean(vals)))
+                results[f"{metric}-stdv"].append(float(np.std(vals)))
+    return dict(results)

@@ -1,0 +1,314 @@
+"""Device-side tree growth: leaf-wise GBDT trees as one jitted XLA program.
+
+TPU re-architecture of SerialTreeLearner::Train
+(reference: src/treelearner/serial_tree_learner.cpp:152-231):
+
+- The reference's per-leaf DataPartition (permuted row indices,
+  data_partition.hpp) becomes a flat `leaf_id[num_rows]` vector — no row
+  movement, ever.
+- The reference's one-split-per-iteration loop with histogram pool becomes a
+  `lax.while_loop` over *waves*: each wave builds histograms for all pending
+  leaves in ONE masked matmul pass (ops/histogram.py), finds their best splits
+  (ops/split_finder.py), then applies up to `wave_size` splits chosen by
+  global gain order via `top_k` — with wave_size=1 this is exactly the
+  reference's leaf-wise ordering; with wave_size=S it amortizes the full-data
+  pass over many splits (the TPU analog of the GPU learner batching all
+  feature-groups into one kernel launch, gpu_tree_learner.cpp:890-975).
+- Sibling histograms come from parent-minus-smaller-child subtraction, as in
+  the reference (serial_tree_learner.cpp:354-362, feature_histogram.hpp:64-70),
+  via a cached `hist[num_leaves+1, F, B, 3]` tensor in HBM.
+- Growth stops when no leaf has a positive-gain split or the leaf budget is
+  exhausted (tree_learner guards serial_tree_learner.cpp:172-189).
+
+Everything is fixed-shape; "no split this wave" is a masked no-op, so the
+whole tree trains in one XLA dispatch with zero host round-trips (the axon
+tunnel costs ~67ms per sync — exp/RESULTS.md).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops.histogram import build_histograms, root_sums
+from .ops.split_finder import (SplitCandidates, find_best_splits_numerical,
+                               leaf_output)
+
+NEG_INF = -jnp.inf
+
+
+class TreeArrays(NamedTuple):
+    """Array-based tree, LightGBM layout (reference: include/LightGBM/tree.h:356-395).
+
+    Internal node arrays have `num_leaves-1` real rows plus one scratch row for
+    masked scatters; leaf arrays likewise `num_leaves`+1. `left_child`/
+    `right_child` >= 0 are internal node ids; negative c encodes leaf ~c.
+    """
+    split_feature: jnp.ndarray    # i32 [M+1] inner feature index
+    threshold_bin: jnp.ndarray    # i32 [M+1]
+    default_left: jnp.ndarray     # bool [M+1]
+    left_child: jnp.ndarray       # i32 [M+1]
+    right_child: jnp.ndarray      # i32 [M+1]
+    split_gain: jnp.ndarray       # f32 [M+1]
+    internal_value: jnp.ndarray   # f32 [M+1] would-be output of internal node
+    internal_count: jnp.ndarray   # f32 [M+1]
+    leaf_value: jnp.ndarray       # f32 [L+1]
+    leaf_count: jnp.ndarray       # f32 [L+1]
+    leaf_parent: jnp.ndarray      # i32 [L+1]
+    num_leaves: jnp.ndarray       # i32 scalar: leaves actually grown
+
+
+class GrowState(NamedTuple):
+    tree: TreeArrays
+    leaf_id: jnp.ndarray          # i32 [N]
+    hist: jnp.ndarray             # f32 [L+1, F, B, 3] per-leaf histogram cache
+    sum_g: jnp.ndarray            # f32 [L+1]
+    sum_h: jnp.ndarray            # f32 [L+1]
+    cnt: jnp.ndarray              # f32 [L+1]
+    leaf_depth: jnp.ndarray       # i32 [L+1]
+    leaf_is_right: jnp.ndarray    # bool [L+1]
+    cand: SplitCandidates         # per-leaf best-split cache, arrays [L+1]
+    needs_hist: jnp.ndarray       # bool [L+1]
+    sib_leaf: jnp.ndarray         # i32 [L+1] sibling to derive by subtraction
+    parent_cache: jnp.ndarray     # i32 [L+1] cache row holding the parent hist
+    num_leaves_cur: jnp.ndarray   # i32
+    done: jnp.ndarray             # bool
+
+
+@dataclass(frozen=True)
+class GrowerSpec:
+    """Static (trace-time) configuration of the grower."""
+    num_leaves: int
+    num_features: int
+    num_bins_padded: int
+    chunk_rows: int
+    hist_slots: int               # leaves histogrammed per pass == max splits/wave
+    wave_size: int                # splits applied per wave (1 = exact leaf-wise)
+    max_depth: int                # <=0: unlimited
+    lambda_l1: float
+    lambda_l2: float
+    min_data_in_leaf: float
+    min_sum_hessian_in_leaf: float
+    min_gain_to_split: float
+
+
+def _empty_tree(L: int) -> TreeArrays:
+    M = L - 1
+    return TreeArrays(
+        split_feature=jnp.zeros(M + 1, jnp.int32),
+        threshold_bin=jnp.zeros(M + 1, jnp.int32),
+        default_left=jnp.zeros(M + 1, bool),
+        left_child=jnp.full(M + 1, -1, jnp.int32),
+        right_child=jnp.full(M + 1, -1, jnp.int32),
+        split_gain=jnp.zeros(M + 1, jnp.float32),
+        internal_value=jnp.zeros(M + 1, jnp.float32),
+        internal_count=jnp.zeros(M + 1, jnp.float32),
+        leaf_value=jnp.zeros(L + 1, jnp.float32),
+        leaf_count=jnp.zeros(L + 1, jnp.float32),
+        leaf_parent=jnp.full(L + 1, -1, jnp.int32),
+        num_leaves=jnp.asarray(1, jnp.int32),
+    )
+
+
+def _empty_cand(L: int) -> SplitCandidates:
+    return SplitCandidates(
+        gain=jnp.full(L + 1, NEG_INF, jnp.float32),
+        feature=jnp.zeros(L + 1, jnp.int32),
+        threshold=jnp.zeros(L + 1, jnp.int32),
+        default_left=jnp.zeros(L + 1, bool),
+        left_g=jnp.zeros(L + 1, jnp.float32),
+        left_h=jnp.zeros(L + 1, jnp.float32),
+        left_c=jnp.zeros(L + 1, jnp.float32),
+    )
+
+
+def grow_tree(
+    X: jnp.ndarray,               # [N, F] bin codes, rows padded with leaf_id=L sentinel
+    grad: jnp.ndarray,            # [N] f32, bagging/padding-masked
+    hess: jnp.ndarray,            # [N] f32
+    included: jnp.ndarray,        # [N] f32 0/1
+    feature_ok: jnp.ndarray,      # [F] bool: feature_fraction mask & non-trivial
+    num_bins: jnp.ndarray,        # [F] i32
+    missing_code: jnp.ndarray,    # [F] i32
+    default_bin: jnp.ndarray,     # [F] i32
+    spec: GrowerSpec,
+) -> Tuple[TreeArrays, jnp.ndarray]:
+    """Grow one tree; returns (tree arrays, final leaf_id per row)."""
+    L = spec.num_leaves
+    M = L - 1
+    S = spec.hist_slots
+    F = spec.num_features
+    B = spec.num_bins_padded
+    N = X.shape[0]
+
+    rg, rh, rc = root_sums(grad, hess, included)
+
+    tree = _empty_tree(L)
+    state = GrowState(
+        tree=tree,
+        leaf_id=jnp.zeros(N, jnp.int32),
+        hist=jnp.zeros((L + 1, F, B, 3), jnp.float32),
+        sum_g=jnp.zeros(L + 1, jnp.float32).at[0].set(rg),
+        sum_h=jnp.zeros(L + 1, jnp.float32).at[0].set(rh),
+        cnt=jnp.zeros(L + 1, jnp.float32).at[0].set(rc),
+        leaf_depth=jnp.zeros(L + 1, jnp.int32),
+        leaf_is_right=jnp.zeros(L + 1, bool),
+        cand=_empty_cand(L),
+        needs_hist=jnp.zeros(L + 1, bool).at[0].set(True),
+        sib_leaf=jnp.full(L + 1, L, jnp.int32),
+        parent_cache=jnp.full(L + 1, L, jnp.int32),
+        num_leaves_cur=jnp.asarray(1, jnp.int32),
+        done=jnp.asarray(False),
+    )
+
+    leaf_iota = jnp.arange(L + 1, dtype=jnp.int32)
+
+    def wave(state: GrowState) -> GrowState:
+        # ---- 1. slot assignment for leaves needing histograms --------------
+        pending = state.needs_hist
+        slot_rank = jnp.cumsum(pending.astype(jnp.int32)) - 1
+        slot_of_leaf = jnp.where(pending, slot_rank, -1).astype(jnp.int32)  # [L+1]
+        # leaf served by each slot (or L = scratch)
+        leaf_of_slot = jnp.full(S, L, jnp.int32).at[
+            jnp.where(pending, slot_rank, S)  # invalid -> dropped (index S OOB)
+        ].set(leaf_iota, mode="drop")
+
+        # ---- 2. one masked pass builds S histograms ------------------------
+        new_hist = build_histograms(
+            X, grad, hess, included, state.leaf_id, slot_of_leaf,
+            num_slots=S, num_bins_padded=B, chunk_rows=spec.chunk_rows)
+
+        # ---- 3. cache write + sibling by subtraction -----------------------
+        slot_valid = leaf_of_slot < L
+        sibs = state.sib_leaf[leaf_of_slot]                       # [S]
+        parent_rows = state.parent_cache[leaf_of_slot]            # [S]
+        parent_hist = state.hist[parent_rows]                     # [S, F, B, 3]
+        sib_hist = parent_hist - new_hist
+        hist = state.hist
+        hist = hist.at[jnp.where(slot_valid, leaf_of_slot, L)].set(new_hist)
+        hist = hist.at[jnp.where(slot_valid, sibs, L)].set(sib_hist)
+
+        # ---- 4. split scan for the 2S touched leaves -----------------------
+        scan_leaves = jnp.concatenate([leaf_of_slot, jnp.where(slot_valid, sibs, L)])
+        scan_hist = jnp.concatenate([new_hist, sib_hist], axis=0)  # [2S, F, B, 3]
+        cand_new = find_best_splits_numerical(
+            scan_hist,
+            state.sum_g[scan_leaves], state.sum_h[scan_leaves], state.cnt[scan_leaves],
+            num_bins, missing_code, default_bin, feature_ok,
+            lambda_l1=spec.lambda_l1, lambda_l2=spec.lambda_l2,
+            min_data_in_leaf=spec.min_data_in_leaf,
+            min_sum_hessian_in_leaf=spec.min_sum_hessian_in_leaf,
+            min_gain_to_split=spec.min_gain_to_split)
+        cand = SplitCandidates(*[
+            old.at[scan_leaves].set(new) for old, new in zip(state.cand, cand_new)])
+        cand = cand._replace(gain=cand.gain.at[L].set(NEG_INF))  # keep scratch row inert
+        needs_hist = jnp.zeros_like(state.needs_hist)
+
+        # ---- 5. choose splits to apply this wave ---------------------------
+        active = leaf_iota < state.num_leaves_cur
+        depth_ok = (spec.max_depth <= 0) | (state.leaf_depth < spec.max_depth)
+        gains = jnp.where(active & depth_ok & jnp.isfinite(cand.gain), cand.gain, NEG_INF)
+        top_gain, top_leaf = jax.lax.top_k(gains, S)
+        budget = L - state.num_leaves_cur
+        cap = min(spec.wave_size, S) if spec.wave_size > 0 else S
+        srank = jnp.arange(S, dtype=jnp.int32)
+        apply = jnp.isfinite(top_gain) & (srank < budget) & (srank < cap)
+        n_apply = jnp.sum(apply.astype(jnp.int32))
+
+        # ---- 6. apply: tree arrays + leaf state ----------------------------
+        p = jnp.where(apply, top_leaf, L)                         # split leaf (L=dummy)
+        nid = jnp.where(apply, state.num_leaves_cur - 1 + srank, M)  # new internal node
+        q = jnp.where(apply, state.num_leaves_cur + srank, L)     # new right leaf
+
+        lg = cand.left_g[p]
+        lh = cand.left_h[p]
+        lc = cand.left_c[p]
+        pg, ph, pc = state.sum_g[p], state.sum_h[p], state.cnt[p]
+        rg_, rh_, rc_ = pg - lg, ph - lh, pc - lc
+
+        t = state.tree
+        t = t._replace(
+            split_feature=t.split_feature.at[nid].set(cand.feature[p]),
+            threshold_bin=t.threshold_bin.at[nid].set(cand.threshold[p]),
+            default_left=t.default_left.at[nid].set(cand.default_left[p]),
+            split_gain=t.split_gain.at[nid].set(cand.gain[p]),
+            internal_value=t.internal_value.at[nid].set(
+                leaf_output(pg, ph, spec.lambda_l1, spec.lambda_l2)),
+            internal_count=t.internal_count.at[nid].set(pc),
+            left_child=t.left_child.at[nid].set(-p - 1),
+            right_child=t.right_child.at[nid].set(-q - 1),
+        )
+        # re-wire the parent pointer that used to reach leaf p
+        prev_node = t.leaf_parent[p]
+        wire_left = jnp.where(apply & (prev_node >= 0) & ~state.leaf_is_right[p],
+                              prev_node, M)
+        wire_right = jnp.where(apply & (prev_node >= 0) & state.leaf_is_right[p],
+                               prev_node, M)
+        t = t._replace(
+            left_child=t.left_child.at[wire_left].set(jnp.where(apply, nid, t.left_child[wire_left])),
+            right_child=t.right_child.at[wire_right].set(jnp.where(apply, nid, t.right_child[wire_right])),
+            leaf_parent=t.leaf_parent.at[p].set(nid).at[q].set(nid),
+            leaf_value=t.leaf_value
+                .at[p].set(leaf_output(lg, lh, spec.lambda_l1, spec.lambda_l2))
+                .at[q].set(leaf_output(rg_, rh_, spec.lambda_l1, spec.lambda_l2)),
+            leaf_count=t.leaf_count.at[p].set(lc).at[q].set(rc_),
+            num_leaves=state.num_leaves_cur + n_apply,
+        )
+        leaf_is_right = state.leaf_is_right.at[p].set(False).at[q].set(True)
+
+        sum_g = state.sum_g.at[p].set(lg).at[q].set(rg_)
+        sum_h = state.sum_h.at[p].set(lh).at[q].set(rh_)
+        cnt = state.cnt.at[p].set(lc).at[q].set(rc_)
+        new_depth = state.leaf_depth[p] + 1
+        leaf_depth = state.leaf_depth.at[p].set(new_depth).at[q].set(new_depth)
+        cand = SplitCandidates(
+            gain=cand.gain.at[p].set(NEG_INF).at[q].set(NEG_INF),
+            feature=cand.feature, threshold=cand.threshold,
+            default_left=cand.default_left, left_g=cand.left_g,
+            left_h=cand.left_h, left_c=cand.left_c)
+
+        # next wave: histogram the smaller child, derive the larger (ref
+        # serial_tree_learner.cpp:354-362)
+        left_smaller = lc <= rc_
+        smaller = jnp.where(left_smaller, p, q)
+        larger = jnp.where(left_smaller, q, p)
+        needs_hist = needs_hist.at[smaller].set(apply, mode="drop")
+        needs_hist = needs_hist.at[L].set(False)
+        sib_leaf = state.sib_leaf.at[smaller].set(larger)
+        parent_cache = state.parent_cache.at[smaller].set(jnp.where(apply, p, L))
+
+        # ---- 7. route rows of split leaves ---------------------------------
+        map_feat = jnp.full(L + 1, -1, jnp.int32).at[p].set(cand.feature[p], mode="drop")
+        map_thr = jnp.zeros(L + 1, jnp.int32).at[p].set(cand.threshold[p], mode="drop")
+        map_dl = jnp.zeros(L + 1, bool).at[p].set(cand.default_left[p], mode="drop")
+        map_right = jnp.zeros(L + 1, jnp.int32).at[p].set(q, mode="drop")
+        map_feat = map_feat.at[L].set(-1)
+
+        lid = state.leaf_id
+        f_row = map_feat[lid]                                     # [N]
+        f_safe = jnp.maximum(f_row, 0)
+        x_bin = jnp.take_along_axis(X, f_safe[:, None], axis=1)[:, 0].astype(jnp.int32)
+        mcode = missing_code[f_safe]
+        nbin = num_bins[f_safe]
+        dbin = default_bin[f_safe]
+        is_missing = ((mcode == 2) & (x_bin == nbin - 1)) | ((mcode == 1) & (x_bin == dbin))
+        go_left = jnp.where(is_missing, map_dl[lid], x_bin <= map_thr[lid])
+        leaf_id = jnp.where((f_row >= 0), jnp.where(go_left, lid, map_right[lid]), lid)
+
+        done = (n_apply == 0) | (state.num_leaves_cur + n_apply >= L)
+        return GrowState(t, leaf_id, hist, sum_g, sum_h, cnt, leaf_depth,
+                         leaf_is_right, cand, needs_hist, sib_leaf, parent_cache,
+                         state.num_leaves_cur + n_apply, done)
+
+    def cond(state: GrowState):
+        return ~state.done
+
+    def body(state: GrowState):
+        return wave(state)
+
+    final = jax.lax.while_loop(cond, body, state)
+    return final.tree, final.leaf_id

@@ -1,0 +1,98 @@
+"""Text data loading: CSV / TSV / LibSVM with auto-detection.
+
+Reference: src/io/parser.{cpp,hpp} (CreateParser format sniffing), plus the
+side-file conventions of src/io/metadata.cpp / dataset_loader.cpp:
+`<data>.query` (query group sizes), `<data>.weight`, `<data>.init` (initial
+scores) are picked up automatically when present.
+
+Host-side preprocessing in NumPy; a native C++ parser is the planned
+replacement for very large files (reference's is C++ too).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+
+
+def _sniff_format(sample_lines: List[str]) -> str:
+    for line in sample_lines:
+        line = line.strip()
+        if not line:
+            continue
+        tokens = line.replace("\t", " ").split()
+        if any(":" in t for t in tokens[1:]):
+            return "libsvm"
+        if "\t" in line:
+            return "tsv"
+        if "," in line:
+            return "csv"
+    return "tsv"
+
+
+def _parse_libsvm(lines: List[str]) -> Tuple[np.ndarray, np.ndarray]:
+    labels = []
+    rows = []
+    max_idx = -1
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        toks = line.split()
+        labels.append(float(toks[0]))
+        feats = {}
+        for t in toks[1:]:
+            k, v = t.split(":", 1)
+            k = int(k)
+            feats[k] = float(v)
+            max_idx = max(max_idx, k)
+        rows.append(feats)
+    X = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
+    for i, feats in enumerate(rows):
+        for k, v in feats.items():
+            X[i, k] = v
+    return X, np.asarray(labels, dtype=np.float64)
+
+
+def load_data_file(path: str, params: Dict) -> Tuple[np.ndarray, Optional[np.ndarray], Dict]:
+    """Returns (features, label, side_metadata). Label column handling follows
+    the reference: default column 0, or `label_column` index / `name:` spec."""
+    with open(path, "r") as fh:
+        lines = fh.read().splitlines()
+    has_header = bool(params.get("has_header") or params.get("header"))
+    header_names: Optional[List[str]] = None
+    fmt = _sniff_format(lines[:20][1 if has_header else 0:])
+    if has_header and fmt != "libsvm":
+        sep = "\t" if fmt == "tsv" else ","
+        header_names = [t.strip() for t in lines[0].split(sep)]
+        lines = lines[1:]
+
+    if fmt == "libsvm":
+        X, label = _parse_libsvm(lines)
+    else:
+        sep = "\t" if fmt == "tsv" else ","
+        mat = np.array(
+            [[float(v) if v not in ("", "na", "NA", "nan", "NaN", "null") else np.nan
+              for v in line.split(sep)]
+             for line in lines if line.strip()], dtype=np.float64)
+        label_spec = str(params.get("label_column", "") or "0")
+        if label_spec.startswith("name:"):
+            if header_names is None:
+                Log.fatal("label_column name: spec requires has_header=true")
+            label_idx = header_names.index(label_spec[5:])
+        else:
+            label_idx = int(label_spec)
+        label = mat[:, label_idx]
+        X = np.delete(mat, label_idx, axis=1)
+        if header_names is not None:
+            header_names = [h for i, h in enumerate(header_names) if i != label_idx]
+
+    side: Dict = {"feature_names": header_names}
+    for suffix, key in ((".query", "group"), (".weight", "weight"), (".init", "init_score")):
+        side_path = path + suffix
+        if os.path.exists(side_path):
+            side[key] = np.loadtxt(side_path, dtype=np.float64)
+    return X, label, side

@@ -1,0 +1,79 @@
+"""JSON model dump (reference: GBDT::DumpModel gbdt_model_text.cpp:13-48,
+Tree::ToJSON / NodeToJSON src/io/tree.cpp)."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..tree import K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK, Tree
+
+_MISSING_NAMES = {0: "None", 1: "Zero", 2: "NaN"}
+
+
+def _node_to_dict(tree: Tree, index: int) -> Dict:
+    if index >= 0:
+        dt = int(tree.decision_type[index])
+        node = {
+            "split_index": index,
+            "split_feature": int(tree.split_feature[index]),
+            "split_gain": float(tree.split_gain[index]),
+        }
+        if dt & K_CATEGORICAL_MASK:
+            cat_idx = int(tree.threshold_bin[index])
+            lo, hi = tree.cat_boundaries[cat_idx], tree.cat_boundaries[cat_idx + 1]
+            bitset = tree.cat_threshold[lo:hi]
+            cats = [i * 32 + j for i in range(len(bitset)) for j in range(32)
+                    if (bitset[i] >> j) & 1]
+            node["threshold"] = "||".join(str(c) for c in cats)
+            node["decision_type"] = "=="
+        else:
+            thr = float(tree.threshold[index])
+            node["threshold"] = 1e308 if np.isinf(thr) else thr
+            node["decision_type"] = "<="
+        node["default_left"] = bool(dt & K_DEFAULT_LEFT_MASK)
+        node["missing_type"] = _MISSING_NAMES[(dt >> 2) & 3]
+        node["internal_value"] = float(tree.internal_value[index])
+        node["internal_count"] = int(tree.internal_count[index])
+        node["left_child"] = _node_to_dict(tree, int(tree.left_child[index]))
+        node["right_child"] = _node_to_dict(tree, int(tree.right_child[index]))
+        return node
+    leaf = ~index
+    return {
+        "leaf_index": leaf,
+        "leaf_value": float(tree.leaf_value[leaf]),
+        "leaf_count": int(tree.leaf_count[leaf]),
+    }
+
+
+def _tree_to_dict(tree: Tree) -> Dict:
+    num_cat = 0 if tree.cat_boundaries is None else len(tree.cat_boundaries) - 1
+    out = {"num_leaves": tree.num_leaves, "num_cat": num_cat,
+           "shrinkage": tree.shrinkage}
+    if tree.num_leaves == 1:
+        out["tree_structure"] = {"leaf_value": float(tree.leaf_value[0])}
+    else:
+        out["tree_structure"] = _node_to_dict(tree, 0)
+    return out
+
+
+def dump_model_dict(booster, num_iteration: Optional[int] = None) -> Dict:
+    K = max(booster.num_model_per_iteration, 1)
+    trees = booster.trees
+    if num_iteration is not None and num_iteration > 0:
+        trees = trees[: num_iteration * K]
+    names = booster.feature_names or \
+        [f"Column_{i}" for i in range(booster.num_total_features)]
+    return {
+        "name": "tree",
+        "version": "v2",
+        "num_class": booster.config.num_class,
+        "num_tree_per_iteration": K,
+        "label_index": 0,
+        "max_feature_idx": booster.num_total_features - 1,
+        "objective": booster.config.objective,
+        "average_output": booster.config.boosting_normalized == "rf",
+        "feature_names": names,
+        "tree_info": [dict(tree_index=i, **_tree_to_dict(t))
+                      for i, t in enumerate(trees)],
+    }

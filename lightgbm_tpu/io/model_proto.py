@@ -1,0 +1,109 @@
+"""Protobuf model format — the reference fork's differentiator.
+
+Reference: proto/model.proto + src/proto/gbdt_model_proto.cpp
+(SaveModelToProto/LoadModelFromProto, boosting.h:194-208). Wire-compatible:
+same message layout and field numbers (see proto/model.proto here), so models
+serialize/parse across implementations.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..tree import Tree
+from ..utils.log import Log
+from . import model_pb2
+from .model_text import _feature_infos, _objective_string
+
+
+def _tree_to_proto(t: Tree, msg) -> None:
+    M = t.num_internal
+    msg.num_leaves = t.num_leaves
+    num_cat = 0 if t.cat_boundaries is None else len(t.cat_boundaries) - 1
+    msg.num_cat = num_cat
+    msg.split_feature.extend(int(v) for v in t.split_feature[:M])
+    msg.split_gain.extend(float(v) for v in t.split_gain[:M])
+    msg.threshold.extend(float(v) for v in t.threshold[:M])
+    msg.decision_type.extend(int(v) for v in t.decision_type[:M])
+    msg.left_child.extend(int(v) for v in t.left_child[:M])
+    msg.right_child.extend(int(v) for v in t.right_child[:M])
+    msg.leaf_value.extend(float(v) for v in t.leaf_value[: t.num_leaves])
+    msg.leaf_count.extend(int(v) for v in t.leaf_count[: t.num_leaves])
+    msg.internal_value.extend(float(v) for v in t.internal_value[:M])
+    msg.internal_count.extend(float(v) for v in t.internal_count[:M])
+    if num_cat > 0:
+        msg.cat_boundaries.extend(int(v) for v in t.cat_boundaries)
+        msg.cat_threshold.extend(int(v) for v in t.cat_threshold)
+    msg.shrinkage = float(t.shrinkage)
+
+
+def _tree_from_proto(msg) -> Tree:
+    num_leaves = msg.num_leaves
+    M = num_leaves - 1
+    tree = Tree(
+        num_leaves=num_leaves,
+        split_feature=np.array(msg.split_feature[:M], dtype=np.int32),
+        threshold_bin=np.zeros(M, dtype=np.int32),
+        threshold=np.array(msg.threshold[:M], dtype=np.float64),
+        decision_type=np.array(msg.decision_type[:M], dtype=np.uint8),
+        left_child=np.array(msg.left_child[:M], dtype=np.int32),
+        right_child=np.array(msg.right_child[:M], dtype=np.int32),
+        split_gain=np.array(msg.split_gain[:M], dtype=np.float64),
+        internal_value=np.array(msg.internal_value[:M], dtype=np.float64),
+        internal_count=np.array(msg.internal_count[:M], dtype=np.int64),
+        leaf_value=np.array(msg.leaf_value[:num_leaves], dtype=np.float64),
+        leaf_count=np.array(msg.leaf_count[:num_leaves], dtype=np.int64),
+        leaf_parent=np.full(max(num_leaves, 1), -1, dtype=np.int32),
+        shrinkage=msg.shrinkage or 1.0,
+    )
+    if msg.num_cat > 0:
+        tree.cat_boundaries = np.array(msg.cat_boundaries, dtype=np.int32)
+        tree.cat_threshold = np.array(msg.cat_threshold, dtype=np.uint32)
+    return tree
+
+
+def save_model_proto(booster, filename: str, num_iteration: Optional[int] = None) -> None:
+    K = max(booster.num_model_per_iteration, 1)
+    trees = booster.trees
+    if num_iteration is not None and num_iteration > 0:
+        trees = trees[: num_iteration * K]
+    m = model_pb2.Model()
+    m.name = "tree"
+    m.num_class = booster.config.num_class
+    m.num_tree_per_iteration = K
+    m.label_index = 0
+    m.max_feature_idx = booster.num_total_features - 1
+    m.objective = _objective_string(booster)
+    m.average_output = booster.config.boosting_normalized == "rf"
+    m.feature_names.extend(booster.feature_names or
+                           [f"Column_{i}" for i in range(booster.num_total_features)])
+    m.feature_infos.extend(_feature_infos(booster))
+    for t in trees:
+        _tree_to_proto(t, m.trees.add())
+    with open(filename, "wb") as fh:
+        fh.write(m.SerializeToString())
+
+
+def load_model_proto(booster, filename: str) -> None:
+    with open(filename, "rb") as fh:
+        m = model_pb2.Model.FromString(fh.read())
+    booster.trees = [_tree_from_proto(t) for t in m.trees]
+    booster.num_model_per_iteration = m.num_tree_per_iteration or 1
+    booster.num_total_features = m.max_feature_idx + 1
+    booster.feature_names = list(m.feature_names)
+    params = dict(booster.params)
+    toks = (m.objective or "regression").split()
+    params["objective"] = toks[0]
+    for tok in toks[1:]:
+        if ":" in tok:
+            k, v = tok.split(":", 1)
+            params[k] = v
+    params["num_class"] = m.num_class or 1
+    if m.average_output:
+        params["boosting_type"] = "rf"
+        params.setdefault("bagging_freq", 1)
+        params.setdefault("bagging_fraction", 0.5)
+    from ..config import Config
+    booster.config = Config.from_params(params)
+    booster.params = params

@@ -1,0 +1,220 @@
+"""LightGBM text model format, round-trippable with the reference.
+
+Writers/readers for the `Tree=i` block format of
+src/boosting/gbdt_model_text.cpp:169-239 (SaveModelToString) /
+:241-330 (LoadModelFromString) and src/io/tree.cpp Tree::ToString/:414
+(parsing constructor). A model saved here loads in the reference C++ and
+vice versa (same keys, same array encodings, same decision_type bit packing).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..tree import Tree
+from ..utils.log import Log
+
+
+def _fmt_double(v: float) -> str:
+    return np.format_float_positional(v, precision=17, trim="0", unique=True) \
+        if np.isfinite(v) else repr(float(v))
+
+
+def _arr_str(arr, fmt=str) -> str:
+    return " ".join(fmt(v) for v in arr)
+
+
+def _tree_to_string(tree: Tree) -> str:
+    M = tree.num_internal
+    num_cat = 0 if tree.cat_boundaries is None else len(tree.cat_boundaries) - 1
+    lines = [
+        f"num_leaves={tree.num_leaves}",
+        f"num_cat={num_cat}",
+        "split_feature=" + _arr_str(tree.split_feature[:M]),
+        "split_gain=" + _arr_str(tree.split_gain[:M], _fmt_double),
+        "threshold=" + _arr_str(tree.threshold[:M], _fmt_double),
+        "decision_type=" + _arr_str(tree.decision_type[:M].astype(np.int64)),
+        "left_child=" + _arr_str(tree.left_child[:M]),
+        "right_child=" + _arr_str(tree.right_child[:M]),
+        "leaf_value=" + _arr_str(tree.leaf_value[: tree.num_leaves], _fmt_double),
+        "leaf_count=" + _arr_str(tree.leaf_count[: tree.num_leaves]),
+        "internal_value=" + _arr_str(tree.internal_value[:M], _fmt_double),
+        "internal_count=" + _arr_str(tree.internal_count[:M]),
+    ]
+    if num_cat > 0:
+        lines.append("cat_boundaries=" + _arr_str(tree.cat_boundaries))
+        lines.append("cat_threshold=" + _arr_str(tree.cat_threshold))
+    lines.append(f"shrinkage={_fmt_double(tree.shrinkage)}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def _objective_string(booster) -> str:
+    from ..objectives import OBJECTIVE_ALIASES
+    cfg = booster.config
+    name = OBJECTIVE_ALIASES.get(cfg.objective, cfg.objective)
+    if name == "binary":
+        return f"binary sigmoid:{cfg.sigmoid:g}"
+    if name == "multiclass":
+        return f"multiclass num_class:{cfg.num_class}"
+    if name == "multiclassova":
+        return f"multiclassova num_class:{cfg.num_class} sigmoid:{cfg.sigmoid:g}"
+    if name == "lambdarank":
+        return "lambdarank"
+    return name
+
+
+def _feature_infos(booster) -> List[str]:
+    """Per-raw-feature info strings (dataset.h:518-530, bin.h:175-184)."""
+    out = []
+    mapper_of_real: Dict[int, object] = {}
+    if booster.mappers:
+        # booster.mappers is indexed by inner feature; map back to raw columns
+        for inner, m in enumerate(booster.mappers):
+            real = int(booster._real_feature_idx[inner]) if hasattr(
+                booster, "_real_feature_idx") else inner
+            mapper_of_real[real] = m
+    for i in range(booster.num_total_features):
+        m = mapper_of_real.get(i)
+        if m is None:
+            out.append("none")
+        elif m.bin_type == "categorical":
+            out.append(":".join(str(c) for c in m.bin_2_categorical))
+        else:
+            out.append(f"[{m.min_val:.17g}:{m.max_val:.17g}]")
+    return out
+
+
+def model_to_string(booster, num_iteration: Optional[int] = None) -> str:
+    K = max(booster.num_model_per_iteration, 1)
+    trees = booster.trees
+    if num_iteration is not None and num_iteration > 0:
+        trees = trees[: num_iteration * K]
+    ss = ["tree"]
+    ss.append(f"num_class={booster.config.num_class}")
+    ss.append(f"num_tree_per_iteration={K}")
+    ss.append("label_index=0")
+    ss.append(f"max_feature_idx={booster.num_total_features - 1}")
+    ss.append(f"objective={_objective_string(booster)}")
+    if booster.config.boosting_normalized == "rf":
+        ss.append("average_output")
+    names = booster.feature_names or [f"Column_{i}" for i in range(booster.num_total_features)]
+    ss.append("feature_names=" + " ".join(names))
+    ss.append("feature_infos=" + " ".join(_feature_infos(booster)))
+    ss.append("")
+    for i, t in enumerate(trees):
+        ss.append(f"Tree={i}")
+        ss.append(_tree_to_string(t))
+    imp = booster.feature_importance("split")
+    pairs = sorted(((int(imp[i]), names[i]) for i in range(len(imp)) if imp[i] > 0),
+                   reverse=True)
+    ss.append("")
+    ss.append("feature importances:")
+    for cnt, nm in pairs:
+        ss.append(f"{nm}={cnt}")
+    ss.append("")
+    return "\n".join(ss)
+
+
+def save_model_file(booster, filename: str, num_iteration: Optional[int] = None) -> None:
+    if booster.config.model_format == "proto" or str(filename).endswith(".proto"):
+        from .model_proto import save_model_proto
+        save_model_proto(booster, filename, num_iteration)
+        return
+    with open(filename, "w") as fh:
+        fh.write(model_to_string(booster, num_iteration))
+
+
+def _parse_tree_block(lines: Dict[str, str]) -> Tree:
+    num_leaves = int(lines["num_leaves"])
+    num_cat = int(lines.get("num_cat", "0"))
+    M = num_leaves - 1
+
+    def ints(key, n, default=0):
+        if key not in lines or not lines[key].strip():
+            return np.full(n, default, dtype=np.int64)
+        return np.array([int(float(t)) for t in lines[key].split()], dtype=np.int64)[:n]
+
+    def floats(key, n):
+        if key not in lines or not lines[key].strip():
+            return np.zeros(n)
+        return np.array([float(t) for t in lines[key].split()], dtype=np.float64)[:n]
+
+    tree = Tree(
+        num_leaves=num_leaves,
+        split_feature=ints("split_feature", M).astype(np.int32),
+        threshold_bin=np.zeros(M, dtype=np.int32),
+        threshold=floats("threshold", M),
+        decision_type=ints("decision_type", M).astype(np.uint8),
+        left_child=ints("left_child", M).astype(np.int32),
+        right_child=ints("right_child", M).astype(np.int32),
+        split_gain=floats("split_gain", M),
+        internal_value=floats("internal_value", M),
+        internal_count=ints("internal_count", M),
+        leaf_value=floats("leaf_value", num_leaves),
+        leaf_count=ints("leaf_count", num_leaves),
+        leaf_parent=np.full(num_leaves, -1, dtype=np.int32),
+        shrinkage=float(lines.get("shrinkage", "1")),
+    )
+    if num_cat > 0:
+        tree.cat_boundaries = ints("cat_boundaries", num_cat + 1).astype(np.int32)
+        nthr = int(tree.cat_boundaries[-1])
+        tree.cat_threshold = ints("cat_threshold", nthr).astype(np.uint32)
+    return tree
+
+
+def load_model_string(booster, model_str: str) -> None:
+    lines = model_str.splitlines()
+    header: Dict[str, str] = {}
+    i = 0
+    trees: List[Tree] = []
+    average_output = False
+    while i < len(lines):
+        line = lines[i].strip()
+        if line.startswith("Tree="):
+            block: Dict[str, str] = {}
+            i += 1
+            while i < len(lines) and lines[i].strip() and "=" in lines[i]:
+                k, v = lines[i].split("=", 1)
+                block[k.strip()] = v.strip()
+                i += 1
+            trees.append(_parse_tree_block(block))
+            continue
+        if line == "average_output":
+            average_output = True
+        elif "=" in line and not line.startswith("feature importances"):
+            k, v = line.split("=", 1)
+            header[k.strip()] = v.strip()
+        elif line == "feature importances:":
+            break
+        i += 1
+
+    booster.trees = trees
+    booster.num_model_per_iteration = int(header.get("num_tree_per_iteration", "1"))
+    booster.num_total_features = int(header.get("max_feature_idx", "-1")) + 1
+    booster.feature_names = header.get("feature_names", "").split()
+    obj_str = header.get("objective", "regression").split()
+    params = dict(booster.params)
+    params["objective"] = obj_str[0]
+    for tok in obj_str[1:]:
+        if ":" in tok:
+            k, v = tok.split(":", 1)
+            params[k] = v
+    params["num_class"] = int(header.get("num_class", "1"))
+    if average_output:
+        params["boosting_type"] = "rf"
+        params.setdefault("bagging_freq", 1)
+        params.setdefault("bagging_fraction", 0.5)
+    from ..config import Config
+    booster.config = Config.from_params(params)
+    booster.params = params
+
+
+def load_model_file(booster, filename: str) -> None:
+    if str(filename).endswith(".proto") or booster.params.get("model_format") == "proto":
+        from .model_proto import load_model_proto
+        load_model_proto(booster, filename)
+        return
+    with open(filename, "r") as fh:
+        load_model_string(booster, fh.read())

@@ -1,0 +1,102 @@
+"""Gradient/hessian histogram construction as MXU one-hot matmuls.
+
+The TPU replacement for the reference's histogram kernels:
+- CPU scatter-add: Bin::ConstructHistogram (src/io/dense_bin.hpp:66-130)
+- OpenCL local-memory atomics (src/treelearner/ocl/histogram256.cl:95-125)
+
+TPUs have no fast scatter (measured ~400x slower than matmul formulation —
+exp/RESULTS.md), so the histogram is computed as a chunked one-hot matmul:
+
+    hist[f, b, s*ch+j] = sum_r (X[r,f] == b) * rhs[r, s*ch+j]
+
+where `rhs` carries per-leaf-slot weight columns: rows whose leaf is assigned
+slot `s` contribute their (gradient, hessian, count) channels to that slot's
+columns, everyone else contributes zero. One pass over the data therefore
+builds histograms for up to S leaves at once — the TPU analog of the
+reference's "histogram for the smaller leaf, sibling by subtraction" pipeline
+(src/treelearner/serial_tree_learner.cpp:354-362).
+
+Precision: the one-hot matrix is exact in bf16; gradients/hessians are split
+into bf16 hi+lo pairs accumulated in f32, giving ~f32-accurate sums at full
+MXU speed (the reference GPU path used plain f32 atomics and accepted small
+accuracy deltas: docs/GPU-Performance.rst:131-133).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# channels per leaf slot: g_hi, g_lo, h_hi, h_lo, count
+NUM_CHANNELS = 5
+
+
+def _split_hi_lo(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    hi = x.astype(jnp.bfloat16)
+    lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def build_histograms(
+    X: jnp.ndarray,          # [N, F] uint8/uint16 bin codes (N padded to chunk multiple)
+    grad: jnp.ndarray,       # [N] f32 (bagging-masked)
+    hess: jnp.ndarray,       # [N] f32 (bagging-masked)
+    included: jnp.ndarray,   # [N] f32 0/1 bagging/padding mask (count channel)
+    leaf_id: jnp.ndarray,    # [N] i32 current leaf of each row (padding rows -> num_leaves)
+    slot_of_leaf: jnp.ndarray,  # [L+1] i32 leaf -> histogram slot, -1 = not pending
+    num_slots: int,
+    num_bins_padded: int,
+    chunk_rows: int,
+) -> jnp.ndarray:
+    """Returns hist [num_slots, F, num_bins_padded, 3] f32 (sum_g, sum_h, count)."""
+    n_rows, num_features = X.shape
+    assert n_rows % chunk_rows == 0, (n_rows, chunk_rows)
+    n_chunks = n_rows // chunk_rows
+    ch = NUM_CHANNELS
+    iota_bins = jnp.arange(num_bins_padded, dtype=jnp.int32)[None, None, :]
+    iota_slots = jnp.arange(num_slots, dtype=jnp.int32)[None, :]
+
+    def chunk_body(acc, i):
+        sl = jax.lax.dynamic_slice_in_dim
+        xc = sl(X, i * chunk_rows, chunk_rows)
+        gc = sl(grad, i * chunk_rows, chunk_rows)
+        hc = sl(hess, i * chunk_rows, chunk_rows)
+        mc = sl(included, i * chunk_rows, chunk_rows)
+        lc = sl(leaf_id, i * chunk_rows, chunk_rows)
+
+        slot = slot_of_leaf[lc]                                   # [R]
+        slot_onehot = (slot[:, None] == iota_slots)               # [R, S] bool
+        g_hi, g_lo = _split_hi_lo(gc)
+        h_hi, h_lo = _split_hi_lo(hc)
+        w = jnp.stack([g_hi, g_lo, h_hi, h_lo, mc.astype(jnp.bfloat16)], axis=-1)  # [R, ch]
+        rhs = (slot_onehot[:, :, None].astype(jnp.bfloat16) * w[:, None, :]
+               ).reshape(chunk_rows, num_slots * ch)              # [R, S*ch]
+
+        onehot = (xc.astype(jnp.int32)[:, :, None] == iota_bins).astype(jnp.bfloat16)  # [R, F, B]
+        part = jax.lax.dot_general(
+            onehot, rhs,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                         # [F, B, S*ch]
+        return acc + part, ()
+
+    acc0 = jnp.zeros((num_features, num_bins_padded, num_slots * ch), jnp.float32)
+    acc, _ = jax.lax.scan(chunk_body, acc0, jnp.arange(n_chunks))
+
+    acc = acc.reshape(num_features, num_bins_padded, num_slots, ch)
+    acc = jnp.transpose(acc, (2, 0, 1, 3))                        # [S, F, B, ch]
+    sum_g = acc[..., 0] + acc[..., 1]
+    sum_h = acc[..., 2] + acc[..., 3]
+    cnt = acc[..., 4]
+    return jnp.stack([sum_g, sum_h, cnt], axis=-1)                # [S, F, B, 3]
+
+
+def root_sums(grad: jnp.ndarray, hess: jnp.ndarray, included: jnp.ndarray
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Total (sum_g, sum_h, count) over included rows — root LeafSplits init
+    (reference: src/treelearner/leaf_splits.hpp Init)."""
+    return (jnp.sum(grad, dtype=jnp.float32),
+            jnp.sum(hess, dtype=jnp.float32),
+            jnp.sum(included, dtype=jnp.float32))

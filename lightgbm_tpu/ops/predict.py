@@ -1,0 +1,64 @@
+"""Vectorized tree traversal over binned data (device).
+
+Replaces the reference's per-row pointer-chasing Tree::GetLeaf
+(include/LightGBM/tree.h:434-487) with a data-parallel frontier walk: every
+row holds its current node id; one step gathers (feature, threshold, children)
+for all rows at once and advances; a `while_loop` runs until all rows sit in
+leaves (bounded by tree depth). Used for validation-score updates during
+training — training rows never traverse (their leaf ids are maintained
+incrementally by the grower).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..grower import TreeArrays
+
+
+def leaves_from_binned(
+    tree: TreeArrays,
+    Xb: jnp.ndarray,            # [N, F] bin codes
+    num_bins: jnp.ndarray,      # [F] i32
+    missing_code: jnp.ndarray,  # [F] i32
+    default_bin: jnp.ndarray,   # [F] i32
+) -> jnp.ndarray:
+    """Leaf index [N] for each row."""
+    N = Xb.shape[0]
+    max_steps = tree.leaf_value.shape[0]  # depth <= num_leaves
+
+    # cur >= 0: internal node id; cur < 0: settled in leaf ~cur
+    cur0 = jnp.where(tree.num_leaves > 1,
+                     jnp.zeros(N, jnp.int32),
+                     jnp.full(N, -1, jnp.int32))
+
+    def cond(carry):
+        cur, steps = carry
+        return jnp.any(cur >= 0) & (steps < max_steps)
+
+    def body(carry):
+        cur, steps = carry
+        at_node = cur >= 0
+        nid = jnp.maximum(cur, 0)
+        f = tree.split_feature[nid]
+        thr = tree.threshold_bin[nid]
+        dl = tree.default_left[nid]
+        b = jnp.take_along_axis(Xb, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        mcode = missing_code[f]
+        nbin = num_bins[f]
+        dbin = default_bin[f]
+        is_missing = ((mcode == 2) & (b == nbin - 1)) | ((mcode == 1) & (b == dbin))
+        go_left = jnp.where(is_missing, dl, b <= thr)
+        child = jnp.where(go_left, tree.left_child[nid], tree.right_child[nid])
+        cur = jnp.where(at_node, child, cur)
+        return cur, steps + 1
+
+    cur, _ = jax.lax.while_loop(cond, body, (cur0, jnp.asarray(0, jnp.int32)))
+    return -cur - 1  # ~cur
+
+
+def add_tree_scores(score: jnp.ndarray, tree: TreeArrays, leaf_ids: jnp.ndarray
+                    ) -> jnp.ndarray:
+    """score += leaf_value[leaf] — the reference's leaf-partition fast path
+    (ScoreUpdater::AddScore with tree_learner, score_updater.hpp:49-56)."""
+    return score + tree.leaf_value[leaf_ids]

@@ -1,0 +1,166 @@
+"""Vectorized best-split search over histograms.
+
+TPU re-formulation of FeatureHistogram::FindBestThreshold
+(reference: src/treelearner/feature_histogram.hpp:72-101,314-455): the
+reference's two sequential scans per feature (dir=+1 / dir=-1 with
+missing-value default-direction learning) become masked cumulative sums over
+the bin axis, evaluated for all (slot, feature, threshold, direction)
+candidates at once, followed by one argmax.
+
+Semantics preserved:
+- gain = GetLeafSplitGain(l) + GetLeafSplitGain(r) with L1 thresholding
+  (feature_histogram.hpp:290-296), candidate valid iff
+  gain > parent_gain + min_gain_to_split (:101,362),
+- MissingType::NaN — the NaN bin (last) is excluded from the accumulating
+  side, so missing rows follow the scan direction's remainder: dir=-1 sends
+  them left (default_left=true), dir=+1 right (:349-357,375-386),
+- MissingType::Zero — the zero bin is excluded likewise and its threshold
+  skipped (skip_default_bin, :338,399),
+- features with num_bin<=2 or MissingType::None scan only dir=-1
+  (:86-99), with the 2-bin NaN default-direction fix (:96-98),
+- min_data_in_leaf / min_sum_hessian_in_leaf constraints on both children.
+
+Categorical features are handled by find_best_splits_categorical (one-hot and
+sorted-prefix modes, feature_histogram.hpp:104-259).
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -jnp.inf
+
+
+class SplitCandidates(NamedTuple):
+    """Best split per histogram slot (device arrays, all [S])."""
+    gain: jnp.ndarray          # f32, improvement over parent (-inf if none)
+    feature: jnp.ndarray       # i32 inner feature index
+    threshold: jnp.ndarray     # i32 bin threshold (left: bin <= threshold)
+    default_left: jnp.ndarray  # bool
+    left_g: jnp.ndarray        # f32 sum of gradients in left child
+    left_h: jnp.ndarray        # f32
+    left_c: jnp.ndarray        # f32 row count in left child
+
+
+def leaf_split_gain(sum_g, sum_h, l1: float, l2: float):
+    """(|g|-l1)_+^2 / (h+l2) — feature_histogram.hpp:290-296."""
+    reg = jnp.maximum(jnp.abs(sum_g) - l1, 0.0)
+    return reg * reg / (sum_h + l2)
+
+
+def leaf_output(sum_g, sum_h, l1: float, l2: float):
+    """-sign(g)(|g|-l1)_+ / (h+l2) — feature_histogram.hpp:304-310."""
+    reg = jnp.maximum(jnp.abs(sum_g) - l1, 0.0)
+    return -jnp.sign(sum_g) * reg / (sum_h + l2)
+
+
+def find_best_splits_numerical(
+    hist: jnp.ndarray,        # [S, F, B, 3] (sum_g, sum_h, count)
+    parent_g: jnp.ndarray,    # [S]
+    parent_h: jnp.ndarray,    # [S]
+    parent_c: jnp.ndarray,    # [S]
+    num_bins: jnp.ndarray,    # [F] i32
+    missing_code: jnp.ndarray,  # [F] i32: 0=none, 1=zero, 2=nan
+    default_bin: jnp.ndarray,   # [F] i32
+    feature_ok: jnp.ndarray,    # [F] bool (non-categorical & feature_fraction mask)
+    *,
+    lambda_l1: float,
+    lambda_l2: float,
+    min_data_in_leaf: float,
+    min_sum_hessian_in_leaf: float,
+    min_gain_to_split: float,
+) -> SplitCandidates:
+    S, F, B, _ = hist.shape
+    g = hist[..., 0]
+    h = hist[..., 1]
+    c = hist[..., 2]
+    bins = jnp.arange(B, dtype=jnp.int32)[None, :]                 # [1, B]
+    nb = num_bins[:, None]                                         # [F, 1]
+    valid_bin = bins < nb                                          # [F, B]
+
+    is_nan = missing_code[:, None] == 2
+    is_zero = missing_code[:, None] == 1
+    full_mode = (num_bins > 2) & (missing_code != 0)               # [F]
+
+    # bins excluded from directional accumulation in full mode
+    excl_full = (is_nan & (bins == nb - 1)) | (is_zero & (bins == default_bin[:, None]))
+    excl = jnp.where(full_mode[:, None], excl_full, False) | ~valid_bin  # [F, B]
+    inc = (~excl).astype(jnp.float32)[None, :, :]                  # [1, F, B]
+
+    cum_g = jnp.cumsum(g * inc, axis=2)
+    cum_h = jnp.cumsum(h * inc, axis=2)
+    cum_c = jnp.cumsum(c * inc, axis=2)
+    tot_g = cum_g[..., -1:]
+    tot_h = cum_h[..., -1:]
+    tot_c = cum_c[..., -1:]
+    pg = parent_g[:, None, None]
+    ph = parent_h[:, None, None]
+    pc = parent_c[:, None, None]
+
+    def child_gains(lg, lh, lc, rg, rh, rc):
+        ok = ((lc >= min_data_in_leaf) & (rc >= min_data_in_leaf)
+              & (lh >= min_sum_hessian_in_leaf) & (rh >= min_sum_hessian_in_leaf))
+        gains = (leaf_split_gain(lg, lh, lambda_l1, lambda_l2)
+                 + leaf_split_gain(rg, rh, lambda_l1, lambda_l2))
+        return jnp.where(ok, gains, NEG_INF)
+
+    # --- forward scan (dir=+1): left = included bins <= t, missing -> right
+    fwd_lg, fwd_lh, fwd_lc = cum_g, cum_h, cum_c
+    fwd_rg, fwd_rh, fwd_rc = pg - fwd_lg, ph - fwd_lh, pc - fwd_lc
+    fwd_thr_ok = (full_mode[:, None]                                # fwd only in full mode
+                  & (bins <= nb - 2)
+                  & ~(is_zero & (bins == default_bin[:, None])))    # skip_default_bin
+    fwd_gain = jnp.where(fwd_thr_ok[None], child_gains(fwd_lg, fwd_lh, fwd_lc,
+                                                       fwd_rg, fwd_rh, fwd_rc), NEG_INF)
+
+    # --- reverse scan (dir=-1): right = included bins > t, missing -> left
+    rev_rg, rev_rh, rev_rc = tot_g - cum_g, tot_h - cum_h, tot_c - cum_c
+    rev_lg, rev_lh, rev_lc = pg - rev_rg, ph - rev_rh, pc - rev_rc
+    rev_max_thr = jnp.where(full_mode & (missing_code == 2), nb[:, 0] - 3, nb[:, 0] - 2)
+    rev_thr_ok = ((bins <= rev_max_thr[:, None]) & (bins >= 0)
+                  & ~(full_mode[:, None] & is_zero & (bins == default_bin[:, None] - 1)))
+    rev_gain = jnp.where(rev_thr_ok[None], child_gains(rev_lg, rev_lh, rev_lc,
+                                                       rev_rg, rev_rh, rev_rc), NEG_INF)
+
+    # default direction: rev sends missing left, except the 2-bin NaN fix
+    # (feature_histogram.hpp:96-98) where missing is the last bin on the right.
+    rev_default_left = ~(~full_mode & (missing_code == 2))          # [F]
+
+    feature_gate = jnp.where(feature_ok[None, :, None], 0.0, NEG_INF)
+    parent_gain_shift = (leaf_split_gain(parent_g, parent_h, lambda_l1, lambda_l2)
+                         + min_gain_to_split)[:, None, None]
+    rev_gain = rev_gain + feature_gate
+    fwd_gain = fwd_gain + feature_gate
+    rev_gain = jnp.where(rev_gain > parent_gain_shift, rev_gain - parent_gain_shift, NEG_INF)
+    fwd_gain = jnp.where(fwd_gain > parent_gain_shift, fwd_gain - parent_gain_shift, NEG_INF)
+
+    # --- pick best over (dir, feature, threshold); rev first to mirror the
+    # reference's dir=-1-then-dir=+1 strict-improvement ordering (:89-93)
+    all_gain = jnp.stack([rev_gain, fwd_gain], axis=1)              # [S, 2, F, B]
+    flat = all_gain.reshape(S, 2 * F * B)
+    best_idx = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
+    d_idx = best_idx // (F * B)
+    f_idx = (best_idx // B) % F
+    t_idx = best_idx % B
+
+    def gather(arr):  # arr [S, F, B] -> [S] at (f_idx, t_idx)
+        return arr[jnp.arange(S), f_idx, t_idx]
+
+    is_rev = d_idx == 0
+    left_g = jnp.where(is_rev, gather(rev_lg), gather(fwd_lg))
+    left_h = jnp.where(is_rev, gather(rev_lh), gather(fwd_lh))
+    left_c = jnp.where(is_rev, gather(rev_lc), gather(fwd_lc))
+    default_left = jnp.where(is_rev, rev_default_left[f_idx], False)
+
+    return SplitCandidates(
+        gain=best_gain,
+        feature=f_idx.astype(jnp.int32),
+        threshold=t_idx.astype(jnp.int32),
+        default_left=default_left,
+        left_g=left_g,
+        left_h=left_h,
+        left_c=left_c,
+    )

@@ -1,0 +1,229 @@
+"""scikit-learn API wrappers (reference: python-package/lightgbm/sklearn.py:137-770)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .engine import train as _train
+from .utils.log import Log
+
+
+class LGBMModel:
+    """Base estimator (reference sklearn.py:137 LGBMModel)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[str] = None, class_weight=None,
+                 min_split_gain: float = 0.0, min_child_weight: float = 1e-3,
+                 min_child_samples: int = 20, subsample: float = 1.0,
+                 subsample_freq: int = 0, colsample_bytree: float = 1.0,
+                 reg_alpha: float = 0.0, reg_lambda: float = 0.0,
+                 random_state: Optional[int] = None, n_jobs: int = -1,
+                 silent: bool = True, importance_type: str = "split", **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.silent = silent
+        self.importance_type = importance_type
+        self._other_params = dict(kwargs)
+        self._Booster: Optional[Booster] = None
+        self._n_features = None
+        self._classes = None
+        self._n_classes = None
+        self._objective = objective
+
+    # sklearn plumbing
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = {
+            "boosting_type": self.boosting_type, "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth, "learning_rate": self.learning_rate,
+            "n_estimators": self.n_estimators,
+            "subsample_for_bin": self.subsample_for_bin, "objective": self.objective,
+            "class_weight": self.class_weight, "min_split_gain": self.min_split_gain,
+            "min_child_weight": self.min_child_weight,
+            "min_child_samples": self.min_child_samples, "subsample": self.subsample,
+            "subsample_freq": self.subsample_freq,
+            "colsample_bytree": self.colsample_bytree, "reg_alpha": self.reg_alpha,
+            "reg_lambda": self.reg_lambda, "random_state": self.random_state,
+            "n_jobs": self.n_jobs, "silent": self.silent,
+            "importance_type": self.importance_type,
+        }
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for key, value in params.items():
+            if hasattr(self, key):
+                setattr(self, key, value)
+            else:
+                self._other_params[key] = value
+        return self
+
+    def _lgb_params(self) -> Dict[str, Any]:
+        params = {
+            "boosting_type": self.boosting_type,
+            "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth,
+            "learning_rate": self.learning_rate,
+            "bin_construct_sample_cnt": self.subsample_for_bin,
+            "min_gain_to_split": self.min_split_gain,
+            "min_sum_hessian_in_leaf": self.min_child_weight,
+            "min_data_in_leaf": self.min_child_samples,
+            "bagging_fraction": self.subsample,
+            "bagging_freq": self.subsample_freq,
+            "feature_fraction": self.colsample_bytree,
+            "lambda_l1": self.reg_alpha,
+            "lambda_l2": self.reg_lambda,
+            "verbose": 0 if self.silent else 1,
+        }
+        if self._objective is not None:
+            params["objective"] = self._objective
+        if self.random_state is not None:
+            params["seed"] = self.random_state
+        params.update(self._other_params)
+        return params
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            early_stopping_rounds=None, verbose=False, feature_name="auto",
+            categorical_feature="auto", callbacks=None):
+        params = self._lgb_params()
+        if eval_metric is not None:
+            params["metric"] = eval_metric
+        if self.class_weight is not None and sample_weight is None:
+            sample_weight = self._class_weights_to_sample_weight(y)
+        train_set = Dataset(X, label=y, weight=sample_weight, group=group,
+                            init_score=init_score, params=params,
+                            feature_name=feature_name,
+                            categorical_feature=categorical_feature)
+        valid_sets = []
+        valid_names = []
+        if eval_set is not None:
+            for i, (vx, vy) in enumerate(eval_set):
+                if vx is X and vy is y:
+                    valid_sets.append(train_set)
+                else:
+                    vw = eval_sample_weight[i] if eval_sample_weight else None
+                    vg = eval_group[i] if eval_group else None
+                    vi = eval_init_score[i] if eval_init_score else None
+                    valid_sets.append(Dataset(vx, label=vy, reference=train_set,
+                                              weight=vw, group=vg, init_score=vi))
+                valid_names.append(eval_names[i] if eval_names else f"valid_{i}")
+        self.evals_result_ = {}
+        self._Booster = _train(
+            params, train_set, num_boost_round=self.n_estimators,
+            valid_sets=valid_sets, valid_names=valid_names,
+            early_stopping_rounds=early_stopping_rounds,
+            evals_result=self.evals_result_,
+            verbose_eval=verbose, callbacks=callbacks)
+        self._n_features = train_set.num_feature()
+        self.best_iteration_ = self._Booster.best_iteration
+        return self
+
+    def _class_weights_to_sample_weight(self, y):
+        y = np.asarray(y)
+        classes, counts = np.unique(y, return_counts=True)
+        if self.class_weight == "balanced":
+            weights = {c: len(y) / (len(classes) * cnt) for c, cnt in zip(classes, counts)}
+        else:
+            weights = dict(self.class_weight)
+        return np.asarray([weights.get(v, 1.0) for v in y], dtype=np.float32)
+
+    def predict(self, X, raw_score: bool = False, num_iteration: Optional[int] = None,
+                pred_leaf: bool = False, pred_contrib: bool = False, **kwargs):
+        return self._Booster.predict(X, raw_score=raw_score,
+                                     num_iteration=num_iteration,
+                                     pred_leaf=pred_leaf, pred_contrib=pred_contrib)
+
+    @property
+    def booster_(self) -> Booster:
+        return self._Booster
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        return self._Booster.feature_importance(self.importance_type)
+
+    @property
+    def n_features_(self):
+        return self._n_features
+
+
+class LGBMRegressor(LGBMModel):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("objective", "regression")
+        super().__init__(**kwargs)
+        self._objective = kwargs.get("objective", "regression")
+
+    def fit(self, X, y, **kwargs):
+        return super().fit(X, y, **kwargs)
+
+
+class LGBMClassifier(LGBMModel):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y)
+        self._classes = np.unique(y)
+        self._n_classes = len(self._classes)
+        self._label_map = {c: i for i, c in enumerate(self._classes)}
+        y_enc = np.asarray([self._label_map[v] for v in y], dtype=np.float64)
+        if self._n_classes > 2:
+            self._objective = self.objective or "multiclass"
+            self._other_params["num_class"] = self._n_classes
+        else:
+            self._objective = self.objective or "binary"
+        return super().fit(X, y_enc, **kwargs)
+
+    def predict_proba(self, X, raw_score=False, num_iteration=None, **kwargs):
+        result = self._Booster.predict(X, raw_score=raw_score,
+                                       num_iteration=num_iteration)
+        if self._n_classes <= 2 and result.ndim == 1:
+            return np.vstack([1.0 - result, result]).T
+        return result
+
+    def predict(self, X, raw_score=False, num_iteration=None, **kwargs):
+        if raw_score:
+            return self._Booster.predict(X, raw_score=True, num_iteration=num_iteration)
+        proba = self.predict_proba(X, num_iteration=num_iteration)
+        idx = np.argmax(proba, axis=1)
+        return self._classes[idx]
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    @property
+    def n_classes_(self):
+        return self._n_classes
+
+
+class LGBMRanker(LGBMModel):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("objective", "lambdarank")
+        super().__init__(**kwargs)
+        self._objective = kwargs.get("objective", "lambdarank")
+
+    def fit(self, X, y, group=None, **kwargs):
+        if group is None:
+            Log.fatal("Should set group for ranking task")
+        return super().fit(X, y, group=group, **kwargs)

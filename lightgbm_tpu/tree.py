@@ -1,0 +1,175 @@
+"""Host-side tree model: export, raw-value prediction, serialization glue.
+
+The device grower (grower.py) produces TreeArrays in *inner* coordinates
+(used-feature indices, bin thresholds). This module converts them into the
+reference's model-space tree (include/LightGBM/tree.h:23): real feature
+indices, real-valued thresholds (bin upper bounds), decision_type bit packing
+(categorical bit 0, default_left bit 1, missing type bits 2-3 —
+tree.h:184-211), and implements NumericalDecision/CategoricalDecision
+semantics for raw-value prediction (tree.h:218-284) vectorized over rows.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from .binning import BIN_CATEGORICAL, K_ZERO_RANGE, MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+MISSING_TYPE_CODE = {MISSING_NONE: 0, MISSING_ZERO: 1, MISSING_NAN: 2}
+CODE_TO_MISSING = {v: k for k, v in MISSING_TYPE_CODE.items()}
+
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+
+
+@dataclass
+class Tree:
+    """One decision tree in model space (reference tree.h:356-395 layout)."""
+    num_leaves: int
+    split_feature: np.ndarray      # i32 [M] real feature index
+    threshold_bin: np.ndarray      # i32 [M]
+    threshold: np.ndarray          # f64 [M] real threshold (bin upper bound)
+    decision_type: np.ndarray      # u8  [M]
+    left_child: np.ndarray         # i32 [M]
+    right_child: np.ndarray        # i32 [M]
+    split_gain: np.ndarray         # f64 [M]
+    internal_value: np.ndarray     # f64 [M]
+    internal_count: np.ndarray     # i64 [M]
+    leaf_value: np.ndarray         # f64 [L]
+    leaf_count: np.ndarray         # i64 [L]
+    leaf_parent: np.ndarray        # i32 [L]
+    shrinkage: float = 1.0
+    # categorical splits: threshold_bin is an index into cat_boundaries
+    cat_boundaries: Optional[np.ndarray] = None   # i32 [ncat+1]
+    cat_threshold: Optional[np.ndarray] = None    # u32 bitset pool
+
+    @property
+    def num_internal(self) -> int:
+        return max(self.num_leaves - 1, 0)
+
+    # -- prediction on raw feature values ------------------------------------
+
+    def _decide(self, node: int, fvals: np.ndarray) -> np.ndarray:
+        """Vectorized Decision (tree.h:287-293) for rows at `node`;
+        returns child (>=0 node, <0 ~leaf) per row."""
+        dt = int(self.decision_type[node])
+        if dt & K_CATEGORICAL_MASK:
+            int_fval = np.where(np.isnan(fvals), -1, fvals).astype(np.int64)
+            cat_idx = int(self.threshold_bin[node])
+            lo, hi = self.cat_boundaries[cat_idx], self.cat_boundaries[cat_idx + 1]
+            bitset = self.cat_threshold[lo:hi]
+            in_set = np.zeros(len(fvals), dtype=bool)
+            ok = (int_fval >= 0) & (int_fval < 32 * len(bitset))
+            iv = np.clip(int_fval, 0, max(32 * len(bitset) - 1, 0))
+            if len(bitset):
+                in_set = ok & ((bitset[iv // 32] >> (iv % 32)) & 1).astype(bool)
+            return np.where(in_set, self.left_child[node], self.right_child[node])
+        missing_type = (dt >> 2) & 3
+        default_left = bool(dt & K_DEFAULT_LEFT_MASK)
+        v = fvals.astype(np.float64)
+        nan_mask = np.isnan(v)
+        if missing_type != 2:
+            v = np.where(nan_mask, 0.0, v)
+        if missing_type == 1:
+            is_default = np.abs(v) <= K_ZERO_RANGE
+        elif missing_type == 2:
+            is_default = nan_mask
+        else:
+            is_default = np.zeros(len(v), dtype=bool)
+        default_child = self.left_child[node] if default_left else self.right_child[node]
+        go_left = v <= self.threshold[node]
+        out = np.where(go_left, self.left_child[node], self.right_child[node])
+        return np.where(is_default, default_child, out)
+
+    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
+        """Leaf index per row, raw feature matrix [N, num_total_features]."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        cur = np.zeros(n, dtype=np.int64)  # start at root node 0
+        out = np.full(n, -1, dtype=np.int64)
+        active = np.arange(n)
+        for _ in range(self.num_leaves + 1):
+            if len(active) == 0:
+                break
+            nodes = cur[active]
+            next_nodes = np.empty(len(active), dtype=np.int64)
+            for node in np.unique(nodes):
+                sel = nodes == node
+                rows = active[sel]
+                next_nodes[sel] = self._decide(int(node),
+                                               X[rows, self.split_feature[node]])
+            settled = next_nodes < 0
+            out[active[settled]] = ~next_nodes[settled]
+            cur[active] = next_nodes
+            active = active[~settled]
+        return out.astype(np.int32)
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return self.leaf_value[self.predict_leaf(X)]
+
+    def shrink(self, rate: float) -> None:
+        """Tree::Shrinkage (tree.h:137-142)."""
+        self.leaf_value = self.leaf_value * rate
+        self.shrinkage *= rate
+
+    def add_bias(self, bias: float) -> None:
+        """Tree::AddBias — fold boost-from-average into the first tree."""
+        self.leaf_value = self.leaf_value + bias
+        self.internal_value = self.internal_value + bias
+
+    def max_depth(self) -> int:
+        if self.num_leaves <= 1:
+            return 0
+        depth = np.zeros(self.num_internal, dtype=np.int64)
+        md = 1
+        for node in range(self.num_internal):
+            d = depth[node]
+            for child in (self.left_child[node], self.right_child[node]):
+                if child >= 0:
+                    depth[child] = d + 1
+                else:
+                    md = max(md, d + 1)
+        return int(md)
+
+
+def tree_from_device_arrays(arrs, mappers, real_feature_idx: np.ndarray) -> Tree:
+    """Convert grower TreeArrays (host numpy pytree) to a model-space Tree."""
+    nl = int(arrs.num_leaves)
+    M = max(nl - 1, 0)
+    L = max(nl, 1)
+    split_feature_inner = np.asarray(arrs.split_feature[:M], dtype=np.int32)
+    threshold_bin = np.asarray(arrs.threshold_bin[:M], dtype=np.int32)
+    default_left = np.asarray(arrs.default_left[:M], dtype=bool)
+
+    threshold = np.zeros(M, dtype=np.float64)
+    decision_type = np.zeros(M, dtype=np.uint8)
+    for i in range(M):
+        mapper = mappers[split_feature_inner[i]]
+        dt = 0
+        if mapper.bin_type == BIN_CATEGORICAL:
+            dt |= K_CATEGORICAL_MASK
+        if default_left[i]:
+            dt |= K_DEFAULT_LEFT_MASK
+        dt |= MISSING_TYPE_CODE[mapper.missing_type] << 2
+        decision_type[i] = dt
+        if mapper.bin_type != BIN_CATEGORICAL:
+            threshold[i] = float(mapper.bin_upper_bound[threshold_bin[i]])
+
+    return Tree(
+        num_leaves=nl,
+        split_feature=real_feature_idx[split_feature_inner].astype(np.int32),
+        threshold_bin=threshold_bin,
+        threshold=threshold,
+        decision_type=decision_type,
+        left_child=np.asarray(arrs.left_child[:M], dtype=np.int32),
+        right_child=np.asarray(arrs.right_child[:M], dtype=np.int32),
+        split_gain=np.asarray(arrs.split_gain[:M], dtype=np.float64),
+        internal_value=np.asarray(arrs.internal_value[:M], dtype=np.float64),
+        internal_count=np.asarray(arrs.internal_count[:M], dtype=np.int64),
+        leaf_value=np.asarray(arrs.leaf_value[:L], dtype=np.float64),
+        leaf_count=np.asarray(arrs.leaf_count[:L], dtype=np.int64),
+        leaf_parent=np.asarray(arrs.leaf_parent[:L], dtype=np.int32),
+    )

@@ -1,0 +1,3 @@
+from .log import Log
+
+__all__ = ["Log"]

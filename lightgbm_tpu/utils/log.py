@@ -1,0 +1,50 @@
+"""Leveled logger mirroring the reference's Log facility.
+
+Reference: include/LightGBM/utils/log.h:43-104 — leveled, thread-local level,
+optional callback sink. Here: a thin layer over `logging` with the same levels
+(Fatal raises, matching Log::Fatal's process-abort role in a library context).
+"""
+from __future__ import annotations
+
+import logging
+
+_logger = logging.getLogger("lightgbm_tpu")
+if not _logger.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter("[LightGBM-TPU] [%(levelname)s] %(message)s"))
+    _logger.addHandler(_h)
+    _logger.setLevel(logging.INFO)
+
+
+class LightGBMError(Exception):
+    """Raised where the reference would Log::Fatal (log.h:93)."""
+
+
+class Log:
+    @staticmethod
+    def set_level(verbose: int) -> None:
+        # reference verbosity semantics: <0 fatal-only, 0 warning, 1 info, >1 debug
+        if verbose < 0:
+            _logger.setLevel(logging.CRITICAL)
+        elif verbose == 0:
+            _logger.setLevel(logging.WARNING)
+        elif verbose == 1:
+            _logger.setLevel(logging.INFO)
+        else:
+            _logger.setLevel(logging.DEBUG)
+
+    @staticmethod
+    def debug(msg: str, *args) -> None:
+        _logger.debug(msg, *args)
+
+    @staticmethod
+    def info(msg: str, *args) -> None:
+        _logger.info(msg, *args)
+
+    @staticmethod
+    def warning(msg: str, *args) -> None:
+        _logger.warning(msg, *args)
+
+    @staticmethod
+    def fatal(msg: str, *args) -> None:
+        raise LightGBMError(msg % args if args else msg)

@@ -1,0 +1,10 @@
+from setuptools import find_packages, setup
+
+setup(
+    name="lightgbm_tpu",
+    version="0.1.0",
+    description="TPU-native gradient boosting framework (LightGBM-compatible API)",
+    packages=find_packages(include=["lightgbm_tpu", "lightgbm_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["numpy", "jax"],
+)

@@ -1,0 +1,10 @@
+"""Test harness config: force a CPU backend with 8 virtual devices so
+multi-chip sharding logic is exercised without TPU hardware (the capability
+the reference never had — its MPI path was only ever CI-tested single-process,
+SURVEY.md §4)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,102 @@
+"""Config/alias system tests (reference surface: include/LightGBM/config.h)."""
+import os
+import tempfile
+
+import pytest
+
+from lightgbm_tpu.config import Config, parse_conf_file, resolve_aliases
+
+
+def test_defaults_match_reference():
+    c = Config()
+    # include/LightGBM/config.h:94-260 defaults
+    assert c.max_bin == 255
+    assert c.num_leaves == 31
+    assert c.learning_rate == 0.1
+    assert c.num_iterations == 100
+    assert c.min_data_in_leaf == 20
+    assert c.min_sum_hessian_in_leaf == 1e-3
+    assert c.bagging_fraction == 1.0
+    assert c.bin_construct_sample_cnt == 200000
+    assert c.boosting_type == "gbdt"
+    assert c.tree_learner == "serial"
+    assert c.max_cat_to_onehot == 4
+    assert c.ndcg_eval_at == [1, 2, 3, 4, 5]
+
+
+def test_aliases():
+    c = Config.from_params({"num_tree": 77, "sub_feature": 0.5, "shrinkage_rate": 0.3,
+                            "min_child_samples": 7, "reg_alpha": 0.25})
+    assert c.num_iterations == 77
+    assert c.feature_fraction == 0.5
+    assert c.learning_rate == 0.3
+    assert c.min_data_in_leaf == 7
+    assert c.lambda_l1 == 0.25
+
+
+def test_alias_priority_longest_name_wins():
+    # reference: config.h:485-495 — longer alias name wins, ties alphabetical
+    r = resolve_aliases({"num_tree": 10, "num_iteration": 20})
+    assert r["num_iterations"] == 20
+    # canonical name always beats aliases
+    r = resolve_aliases({"num_iterations": 5, "num_boost_round": 50})
+    assert r["num_iterations"] == 5
+
+
+def test_bool_coercion():
+    c = Config.from_params({"is_unbalance": "true", "use_missing": "false"})
+    assert c.is_unbalance is True
+    assert c.use_missing is False
+    c = Config.from_params({"is_unbalance": "+", "use_missing": "-"})
+    assert c.is_unbalance is True
+    assert c.use_missing is False
+
+
+def test_conf_file_roundtrip(tmp_path):
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        "task = train\n"
+        "boosting_type = gbdt\n"
+        "objective = binary\n"
+        "metric = binary_logloss,auc\n"
+        "metric_freq = 1\n"
+        "is_training_metric = true\n"
+        "max_bin = 255\n"
+        "# comment line\n"
+        "num_trees = 100  # trailing comment\n"
+        "learning_rate = 0.05\n"
+        "num_leaves = 63\n")
+    c = Config.from_conf_file(str(conf))
+    assert c.objective == "binary"
+    assert c.metric == ["binary_logloss", "auc"]
+    assert c.num_iterations == 100
+    assert c.learning_rate == 0.05
+    assert c.num_leaves == 63
+    assert c.is_training_metric is True
+
+
+def test_reference_example_confs_parse():
+    """The bundled reference example configs must parse unchanged."""
+    ref = "/root/reference/examples"
+    if not os.path.isdir(ref):
+        pytest.skip("reference not mounted")
+    for sub in ("binary_classification", "regression", "lambdarank",
+                "multiclass_classification"):
+        path = os.path.join(ref, sub, "train.conf")
+        c = Config.from_conf_file(path)
+        assert c.num_iterations > 0
+
+
+def test_validation():
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        Config.from_params({"num_leaves": 1})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"feature_fraction": 0.0})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"boosting_type": "rf"})  # rf needs bagging
+
+
+def test_max_leaves_by_depth():
+    c = Config.from_params({"num_leaves": 1000, "max_depth": 5})
+    assert c.max_leaves_by_depth == 32

@@ -1,0 +1,216 @@
+"""End-to-end training tests, mirroring the reference's metric-threshold
+strategy (tests/python_package_test/test_engine.py — binary logloss < 0.15
+at :34, regression MSE < 16 at :81, multiclass logloss < 0.2 at :281)."""
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_digits, load_iris, make_regression
+from sklearn.metrics import log_loss, mean_squared_error, roc_auc_score
+from sklearn.model_selection import train_test_split
+
+import lightgbm_tpu as lgb
+
+
+def _split(X, y, seed=42):
+    return train_test_split(X, y, test_size=0.1, random_state=seed)
+
+
+def test_binary():
+    X, y = load_breast_cancer(return_X_y=True)
+    X_train, X_test, y_train, y_test = _split(X, y)
+    params = {"objective": "binary", "metric": "binary_logloss", "verbose": -1,
+              "num_leaves": 31, "learning_rate": 0.1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    valid = lgb.Dataset(X_test, label=y_test, reference=ds)
+    evals_result = {}
+    bst = lgb.train(params, ds, num_boost_round=50, valid_sets=[valid],
+                    evals_result=evals_result, verbose_eval=False)
+    pred = bst.predict(X_test)
+    ll = log_loss(y_test, pred)
+    # reference threshold: 0.15 (test_engine.py:34-54)
+    assert ll < 0.15
+    assert evals_result["valid_0"]["binary_logloss"][-1] == pytest.approx(ll, abs=1e-3)
+
+
+def test_regression():
+    X, y = make_regression(n_samples=2000, n_features=20, n_informative=10,
+                           noise=10.0, random_state=7)
+    X_train, X_test, y_train, y_test = _split(X, y)
+    params = {"objective": "regression", "metric": "l2", "verbose": -1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    valid = lgb.Dataset(X_test, label=y_test, reference=ds)
+    evals_result = {}
+    bst = lgb.train(params, ds, num_boost_round=80, valid_sets=[valid],
+                    evals_result=evals_result, verbose_eval=False)
+    mse = mean_squared_error(y_test, bst.predict(X_test))
+    var = float(np.var(y_test))
+    assert mse < 0.15 * var  # explains >85% of variance
+    assert evals_result["valid_0"]["l2"][-1] == pytest.approx(mse, rel=1e-3)
+
+
+def test_binary_auc():
+    X, y = load_breast_cancer(return_X_y=True)
+    X_train, X_test, y_train, y_test = _split(X, y)
+    params = {"objective": "binary", "metric": "auc", "verbose": -1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    auc = roc_auc_score(y_test, bst.predict(X_test))
+    assert auc > 0.98
+
+
+def test_multiclass():
+    X, y = load_digits(n_class=10, return_X_y=True)
+    X_train, X_test, y_train, y_test = _split(X, y)
+    params = {"objective": "multiclass", "metric": "multi_logloss",
+              "num_class": 10, "verbose": -1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    pred = bst.predict(X_test)
+    assert pred.shape == (len(y_test), 10)
+    assert log_loss(y_test, pred) < 0.35
+    acc = (pred.argmax(axis=1) == y_test).mean()
+    assert acc > 0.9
+
+
+def test_multiclass_ova():
+    X, y = load_iris(return_X_y=True)
+    X_train, X_test, y_train, y_test = _split(X, y)
+    params = {"objective": "multiclassova", "metric": "multi_error",
+              "num_class": 3, "verbose": -1, "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    pred = bst.predict(X_test)
+    acc = (pred.argmax(axis=1) == y_test).mean()
+    assert acc > 0.9
+
+
+def test_missing_value_handling_na():
+    """Mirror of reference test_engine.py:100-140 missing-value tests."""
+    rng = np.random.default_rng(11)
+    N = 2000
+    x = rng.standard_normal(N)
+    y = (x > 0.3).astype(np.float64)
+    X = x.reshape(-1, 1).copy()
+    nan_idx = rng.choice(N, 300, replace=False)
+    y[nan_idx] = 1.0
+    X[nan_idx, 0] = np.nan  # NaN rows are all positive -> model must learn it
+    params = {"objective": "binary", "metric": "binary_logloss", "verbose": -1,
+              "min_data_in_leaf": 1}
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(params, ds, num_boost_round=40, verbose_eval=False)
+    pred_nan = bst.predict(np.array([[np.nan]]))
+    pred_neg = bst.predict(np.array([[-2.0]]))
+    pred_pos = bst.predict(np.array([[2.0]]))
+    assert pred_nan[0] > 0.8
+    assert pred_neg[0] < 0.2
+    assert pred_pos[0] > 0.8
+
+
+def test_missing_value_zero_as_missing():
+    """zero_as_missing=true: zeros follow the learned default direction
+    (reference test_engine.py:176-212)."""
+    rng = np.random.default_rng(12)
+    N = 2000
+    x = rng.uniform(-2, 2, N)
+    zero_idx = rng.choice(N, 400, replace=False)
+    x[zero_idx] = 0.0
+    y = np.where(x == 0.0, 1.0, (x > 0.5).astype(np.float64))
+    X = x.reshape(-1, 1)
+    params = {"objective": "binary", "verbose": -1, "zero_as_missing": True,
+              "min_data_in_leaf": 1}
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(params, ds, num_boost_round=40, verbose_eval=False)
+    assert bst.predict(np.array([[0.0]]))[0] > 0.7
+    assert bst.predict(np.array([[-1.5]]))[0] < 0.3
+
+
+def test_early_stopping():
+    X, y = load_breast_cancer(return_X_y=True)
+    X_train, X_test, y_train, y_test = _split(X, y)
+    params = {"objective": "binary", "metric": "binary_logloss", "verbose": -1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    valid = lgb.Dataset(X_test, label=y_test, reference=ds)
+    bst = lgb.train(params, ds, num_boost_round=300, valid_sets=[valid],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert bst.best_iteration > 0
+    assert bst.current_iteration() < 300
+
+
+def test_weighted_training():
+    X, y = load_breast_cancer(return_X_y=True)
+    w = np.where(y > 0, 2.0, 1.0)
+    params = {"objective": "binary", "verbose": -1}
+    ds = lgb.Dataset(X, label=y, weight=w)
+    bst = lgb.train(params, ds, num_boost_round=20, verbose_eval=False)
+    pred = bst.predict(X)
+    assert log_loss(y, pred) < 0.2
+
+
+def test_bagging_and_feature_fraction():
+    X, y = load_breast_cancer(return_X_y=True)
+    X_train, X_test, y_train, y_test = _split(X, y)
+    params = {"objective": "binary", "verbose": -1, "bagging_fraction": 0.7,
+              "bagging_freq": 1, "feature_fraction": 0.7, "seed": 7}
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train(params, ds, num_boost_round=50, verbose_eval=False)
+    auc = roc_auc_score(y_test, bst.predict(X_test))
+    assert auc > 0.97
+
+
+def test_exact_leafwise_mode():
+    """tpu_wave_size=1 reproduces strict one-leaf-at-a-time growth."""
+    X, y = make_regression(n_samples=500, n_features=5, noise=5.0, random_state=3)
+    params = {"objective": "regression", "verbose": -1, "tpu_wave_size": 1,
+              "num_leaves": 15}
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(params, ds, num_boost_round=20, verbose_eval=False)
+    mse = mean_squared_error(y, bst.predict(X))
+    assert mse < 0.3 * np.var(y)
+    for t in bst.trees:
+        assert t.num_leaves <= 15
+
+
+def test_lambda_l1_l2():
+    X, y = make_regression(n_samples=800, n_features=10, noise=5.0, random_state=5)
+    for l1, l2 in [(0.0, 10.0), (5.0, 0.0), (2.0, 2.0)]:
+        params = {"objective": "regression", "verbose": -1,
+                  "lambda_l1": l1, "lambda_l2": l2}
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train(params, ds, num_boost_round=20, verbose_eval=False)
+        mse = mean_squared_error(y, bst.predict(X))
+        assert mse < 0.5 * np.var(y)
+
+
+def test_objectives_run():
+    """Every non-rank objective trains and improves on its default metric."""
+    rng = np.random.default_rng(9)
+    N = 800
+    X = rng.standard_normal((N, 8))
+    y_reg = np.abs(X[:, 0] * 2 + X[:, 1] + 0.1 * rng.standard_normal(N)) + 0.1
+    y_bin = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    y_prob = 1.0 / (1.0 + np.exp(-(X[:, 0] + X[:, 1])))
+    cases = [
+        ("regression_l1", y_reg), ("huber", y_reg), ("fair", y_reg),
+        ("poisson", y_reg), ("xentropy", y_prob), ("xentlambda", y_prob),
+        ("binary", y_bin),
+    ]
+    for obj, y in cases:
+        params = {"objective": obj, "verbose": -1, "min_data_in_leaf": 5}
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train(params, ds, num_boost_round=25, verbose_eval=False)
+        pred = bst.predict(X)
+        assert np.isfinite(pred).all(), obj
+
+
+def test_prediction_shapes():
+    X, y = load_breast_cancer(return_X_y=True)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, ds,
+                    num_boost_round=10, verbose_eval=False)
+    assert bst.predict(X).shape == (len(y),)
+    assert bst.predict(X, raw_score=True).shape == (len(y),)
+    leaves = bst.predict(X, pred_leaf=True)
+    assert leaves.shape == (len(y), bst.num_trees())
+    assert bst.predict(X[0]).shape == (1,)
+    # num_iteration truncation
+    p5 = bst.predict(X, num_iteration=5)
+    assert not np.allclose(p5, bst.predict(X))

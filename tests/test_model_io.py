@@ -1,0 +1,86 @@
+"""Model save/load round-trip oracles (reference CI: proto round-trip task,
+.travis/test.sh TASK=proto; text format: gbdt_model_text.cpp)."""
+import json
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, make_regression
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    X, y = load_breast_cancer(return_X_y=True)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 15},
+                    ds, num_boost_round=10, verbose_eval=False)
+    return bst, X, y
+
+
+def test_text_roundtrip(trained, tmp_path):
+    bst, X, y = trained
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    p0 = bst.predict(X, raw_score=True)
+    p1 = loaded.predict(X, raw_score=True)
+    np.testing.assert_allclose(p0, p1, rtol=1e-9, atol=1e-12)
+    # converted output too (objective restored from the model header)
+    np.testing.assert_allclose(bst.predict(X), loaded.predict(X), rtol=1e-9)
+
+
+def test_model_string_roundtrip(trained):
+    bst, X, y = trained
+    s = bst.model_to_string()
+    assert s.startswith("tree\n")
+    assert "feature_infos=" in s and "Tree=0" in s
+    loaded = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst.predict(X), loaded.predict(X), rtol=1e-9)
+
+
+def test_proto_roundtrip(trained, tmp_path):
+    bst, X, y = trained
+    path = str(tmp_path / "model.proto")
+    bst.save_model(path)
+    loaded = lgb.Booster(params={"model_format": "proto"}, model_file=path)
+    np.testing.assert_allclose(bst.predict(X), loaded.predict(X), rtol=1e-9)
+
+
+def test_json_dump(trained):
+    bst, X, y = trained
+    d = bst.dump_model()
+    json.dumps(d)  # must be serializable
+    assert d["num_class"] == 1
+    assert len(d["tree_info"]) == bst.num_trees()
+    root = d["tree_info"][0]["tree_structure"]
+    assert "split_feature" in root
+    assert root["decision_type"] in ("<=", "==")
+    # leaf counts sum to dataset size at the root's children depth
+    t0 = bst.trees[0]
+    assert t0.leaf_count.sum() == len(y)
+
+
+def test_truncated_save(trained, tmp_path):
+    bst, X, y = trained
+    path = str(tmp_path / "m5.txt")
+    bst.save_model(path, num_iteration=5)
+    loaded = lgb.Booster(model_file=path)
+    assert loaded.num_trees() == 5
+    np.testing.assert_allclose(loaded.predict(X, raw_score=True),
+                               bst.predict(X, raw_score=True, num_iteration=5),
+                               rtol=1e-9)
+
+
+def test_multiclass_model_io(tmp_path):
+    from sklearn.datasets import load_iris
+    X, y = load_iris(return_X_y=True)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3, "verbose": -1,
+                     "min_data_in_leaf": 5}, ds, num_boost_round=8,
+                    verbose_eval=False)
+    path = str(tmp_path / "mc.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    assert loaded.num_model_per_iteration == 3
+    np.testing.assert_allclose(bst.predict(X), loaded.predict(X), rtol=1e-8)
