@@ -1,0 +1,65 @@
+"""Benchmark harness: Higgs-config training throughput on one TPU chip.
+
+Reference workload (BASELINE.md / docs/Experiments.rst:106): LightGBM CPU
+trains HIGGS (10.5M rows x 28 features) for 500 iterations with
+num_leaves=255, max_bin=255, lr=0.1 in 238.505 s on 2x E5-2670v3 =>
+10.5e6 * 500 / 238.505 = 22,012 Mrow-tree/s.
+
+This harness trains the same config on a synthetic Higgs-shaped dataset
+(dense floats, 28 features — histogram cost depends on shape, not values),
+measures steady-state wall-clock per boosting iteration on-device, and
+reports throughput in Mrow-tree/s. vs_baseline > 1 means faster than the
+reference CPU headline.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_MROW_TREE_PER_S = 10.5e6 * 500 / 238.505 / 1e6   # 22,012
+
+
+def main():
+    import jax
+    import lightgbm_tpu as lgb
+
+    n_rows = int(2 ** 21)          # 2.1M rows: same per-pass regime as HIGGS
+    n_features = 28
+    rng = np.random.RandomState(0)
+    X = rng.rand(n_rows, n_features).astype(np.float32)
+    logit = X[:, 0] * 4 - X[:, 1] * 2 + X[:, 2] * X[:, 3] * 3 - 2
+    y = (logit + rng.randn(n_rows) * 0.5 > 0).astype(np.float32)
+
+    params = dict(
+        objective="binary", num_leaves=255, max_bin=255, learning_rate=0.1,
+        min_data_in_leaf=100, verbose=-1, metric="none",
+    )
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params=params, train_set=ds)
+
+    warmup, timed = 3, 15
+    for _ in range(warmup):
+        bst.update()
+    # force all queued work to finish before starting the clock
+    np.asarray(bst._gbdt.score).sum()
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        bst.update()
+    np.asarray(bst._gbdt.score).sum()
+    elapsed = time.perf_counter() - t0
+
+    mrow_tree_per_s = n_rows * timed / elapsed / 1e6
+    print(json.dumps({
+        "metric": "higgs_train_throughput",
+        "value": round(mrow_tree_per_s, 1),
+        "unit": "Mrow-tree/s",
+        "vs_baseline": round(mrow_tree_per_s / BASELINE_MROW_TREE_PER_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

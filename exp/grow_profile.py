@@ -1,0 +1,57 @@
+"""Profile grow_tree / build_histograms on the real chip, Higgs shapes."""
+import time
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.grower import GrowerSpec, grow_tree
+from lightgbm_tpu.ops.histogram import build_histograms
+
+N = 2 ** 21
+F = 28
+rng = np.random.RandomState(0)
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    jax.tree.util.tree_leaves(out)[0].block_until_ready()
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).sum()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).sum()
+    return (time.perf_counter() - t0) / reps
+
+
+for B, L, slots, chunk in [(64, 63, 16, 32768), (256, 255, 16, 32768),
+                           (256, 255, 16, 131072), (64, 255, 16, 32768),
+                           (256, 255, 8, 32768)]:
+    X = rng.randint(0, B, size=(N, F)).astype(np.uint8)
+    Xd = jnp.asarray(X)
+    g = jnp.asarray(rng.randn(N).astype(np.float32))
+    h = jnp.ones(N, jnp.float32)
+    inc = jnp.ones(N, jnp.float32)
+    num_bins = jnp.full(F, B, jnp.int32)
+    missing_code = jnp.zeros(F, jnp.int32)
+    default_bin = jnp.zeros(F, jnp.int32)
+    fok = jnp.ones(F, bool)
+    leaf_id = jnp.zeros(N, jnp.int32)
+    slot_of_leaf = jnp.zeros(L + 1, jnp.int32).at[1:].set(-1)
+
+    t_hist = timeit(jax.jit(lambda: build_histograms(
+        Xd, g, h, inc, leaf_id, slot_of_leaf, num_slots=slots,
+        num_bins_padded=B, chunk_rows=chunk)))
+
+    spec = GrowerSpec(num_leaves=L, num_features=F, num_bins_padded=B,
+                      chunk_rows=chunk, hist_slots=slots, wave_size=slots,
+                      max_depth=0, lambda_l1=0.0, lambda_l2=0.0,
+                      min_data_in_leaf=100.0, min_sum_hessian_in_leaf=1e-3,
+                      min_gain_to_split=0.0)
+    is_cat = jnp.zeros(F, bool)
+    grow = jax.jit(lambda: grow_tree(Xd, g, h, inc, fok, is_cat, num_bins,
+                                     missing_code, default_bin, spec))
+    t_grow = timeit(grow, reps=3)
+    print(f"B={B} L={L} slots={slots} chunk={chunk}: hist {t_hist*1e3:.1f} ms, "
+          f"grow_tree {t_grow*1e3:.1f} ms")

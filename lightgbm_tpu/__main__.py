@@ -1,0 +1,5 @@
+"""`python -m lightgbm_tpu` — CLI entry (reference src/main.cpp)."""
+from .cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

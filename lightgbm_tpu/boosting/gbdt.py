@@ -28,10 +28,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..dataset import ConstructedDataset, Metadata
 from ..grower import GrowerSpec, TreeArrays, grow_tree
+from ..parallel.comm import make_parallel_context
 from ..metrics import Metric, create_metrics
 from ..objectives import Objective, create_objective
 from ..ops.predict import leaves_from_binned
@@ -81,29 +83,39 @@ class GBDT:
         self.num_models = self.objective.num_models if self.objective else max(config.num_class, 1)
         K = self.num_models
 
+        # ---- device mesh / parallel strategy (reference Network::Init,
+        #      application.cpp:167-178; tree_learner grid tree_learner.cpp:9) --
+        self.pctx = make_parallel_context(config)
+
         N = train_set.num_data
         F = train_set.num_features
-        chunk = min(config.tpu_hist_chunk, _round_up(max(N, 1), 256))
-        Npad = _round_up(max(N, 1), chunk)
+        # feature padding: block-partitioned strategies need F % devices == 0
+        F_pad = self.pctx.pad_features_to(max(F, 1))
+        # row padding: per-device rows must be a chunk multiple
+        Drow = self.pctx.pad_rows_multiple()
+        per_target = max((N + Drow - 1) // Drow, 1)
+        chunk = min(config.tpu_hist_chunk, _round_up(per_target, 256))
+        Npad = _round_up(per_target, chunk) * Drow
         self.num_data = N
         self.num_data_padded = Npad
 
         Xb = train_set.X_binned
-        self.Xb = jnp.asarray(np.pad(Xb, ((0, Npad - N), (0, 0))))
-        self.label = jnp.asarray(np.pad(train_set.metadata.label, (0, Npad - N)))
+        self.Xb = self._put(np.pad(Xb, ((0, Npad - N), (0, F_pad - F))), "rows0")
+        self.label = self._put(np.pad(train_set.metadata.label, (0, Npad - N)), "rows")
         w = train_set.metadata.weight
-        self.weight = None if w is None else jnp.asarray(np.pad(w, (0, Npad - N)))
-        self.pad_mask = jnp.asarray(
-            (np.arange(Npad) < N).astype(np.float32))
+        self.weight = None if w is None else self._put(np.pad(w, (0, Npad - N)), "rows")
+        self.pad_mask = self._put((np.arange(Npad) < N).astype(np.float32), "rows")
 
         meta = train_set.feature_meta_arrays()
-        self.num_bins = jnp.asarray(meta["num_bins"])
-        self.missing_code = jnp.asarray(meta["missing_code"])
-        self.default_bin = jnp.asarray(meta["default_bin"])
+        fpad = F_pad - F
+        self.num_bins = self._put(np.pad(meta["num_bins"], (0, fpad), constant_values=1))
+        self.missing_code = self._put(np.pad(meta["missing_code"], (0, fpad)))
+        self.default_bin = self._put(np.pad(meta["default_bin"], (0, fpad)))
         self.is_categorical_np = meta["is_categorical"]
-        # categorical split search lands in a later milestone: exclude those
-        # features from split search for now (they still bin + route fine).
-        self.feature_ok_base = jnp.asarray(~meta["is_categorical"])
+        is_cat_pad = np.pad(meta["is_categorical"], (0, fpad))
+        self.is_cat = self._put(is_cat_pad)
+        ok = np.arange(F_pad) < F                           # padding features off
+        self.feature_ok_base = self._put(ok)
 
         num_leaves = config.max_leaves_by_depth
         Bpad = max(8, _round_up(train_set.max_num_bin, 8))
@@ -111,7 +123,7 @@ class GBDT:
         wave = config.tpu_wave_size or slots
         self.spec = GrowerSpec(
             num_leaves=num_leaves,
-            num_features=F,
+            num_features=F_pad,
             num_bins_padded=Bpad,
             chunk_rows=chunk,
             hist_slots=slots,
@@ -122,10 +134,17 @@ class GBDT:
             min_data_in_leaf=float(config.min_data_in_leaf),
             min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
             min_gain_to_split=config.min_gain_to_split,
+            num_block_features=self.pctx.block_features(F_pad),
+            use_categorical=bool(meta["is_categorical"].any()),
+            cat_smooth=config.cat_smooth,
+            cat_l2=config.cat_l2,
+            max_cat_threshold=config.max_cat_threshold,
+            max_cat_to_onehot=config.max_cat_to_onehot,
+            min_data_per_group=float(config.min_data_per_group),
         )
+        self.comm = self.pctx.make_comm(F_pad)
 
         # feature_fraction: number of features used per tree
-        n_usable = int(np.sum(~self.is_categorical_np))
         self.n_feature_sample = max(1, int(round(config.feature_fraction * F)))
         self.use_feature_fraction = config.feature_fraction < 1.0 and self.n_feature_sample < F
 
@@ -149,14 +168,15 @@ class GBDT:
             is_arr = np.asarray(meta_is, dtype=np.float32).reshape(K, N, order="C") \
                 if len(meta_is) == K * N else np.tile(np.asarray(meta_is, np.float32), (K, 1))
             base[:, :N] += is_arr
-        self.score = jnp.asarray(base)
+        self.score = self._put(base, "rows1")
 
         self.models: List[List] = []        # per iteration: list of K device TreeArrays
         self._num_leaves_dev: List = []     # per iteration: [K] device array
         self.iter_ = 0
         self.best_iter: Dict[str, int] = {}
         self.best_score: Dict[str, float] = {}
-        self._rng_key = jax.random.PRNGKey(config.seed if config.seed else config.bagging_seed)
+        self._rng_key = self._put(
+            jax.random.PRNGKey(config.seed if config.seed else config.bagging_seed))
 
         self.bagging_on = config.bagging_freq > 0 and config.bagging_fraction < 1.0
         self.bag_mask = self.pad_mask
@@ -167,17 +187,39 @@ class GBDT:
 
     # ------------------------------------------------------------------ setup
 
+    def _put(self, x, kind: str = "repl"):
+        """Place an array on this booster's device(s).
+
+        kind: "rows" ([N] sharded), "rows0" ([N, F] rows on dim 0),
+        "rows1" ([K, N] rows on dim 1), "repl" (replicated). Row sharding only
+        applies to row-partitioned strategies (data/voting); the feature
+        strategy replicates rows like the reference's FeatureParallel learner
+        (every machine holds all data, feature_parallel_tree_learner.cpp).
+        """
+        pctx = self.pctx
+        x = jnp.asarray(x)
+        if pctx.mesh is None:
+            return jax.device_put(x, pctx.devices[0])
+        if kind == "repl" or pctx.strategy == "feature":
+            return jax.device_put(x, NamedSharding(pctx.mesh, P()))
+        spec = {"rows": P(pctx.ROW_AXIS), "rows0": P(pctx.ROW_AXIS, None),
+                "rows1": P(None, pctx.ROW_AXIS)}[kind]
+        return jax.device_put(x, NamedSharding(pctx.mesh, spec))
+
     def add_valid(self, name: str, binned: np.ndarray, metadata: Metadata) -> None:
         nv = binned.shape[0]
         metrics = create_metrics(self.config, self.objective.name if self.objective else None)
         for m in metrics:
             m.init(metadata, nv)
-        vs = ValidSet(name, jnp.asarray(binned), metadata, metrics, nv)
+        F_pad = self.spec.num_features
+        if binned.shape[1] < F_pad:
+            binned = np.pad(binned, ((0, 0), (0, F_pad - binned.shape[1])))
+        vs = ValidSet(name, self._put(binned), metadata, metrics, nv)
         base = np.full((self.num_models, nv), self.init_score_value, dtype=np.float32)
         if metadata.init_score is not None:
             base += np.asarray(metadata.init_score, np.float32).reshape(
                 self.num_models, nv)
-        vs.score = jnp.asarray(base)
+        vs.score = self._put(base)
         self.valid_sets.append(vs)
 
     # ------------------------------------------------------------- train step
@@ -213,6 +255,12 @@ class GBDT:
     def _make_step(self, custom_grads: bool = False):
         spec = self.spec
         K = self.num_models
+        comm = self.comm
+
+        def grow_fn(X, g, h, inc, fok, iscat, nb, mc, db):
+            return grow_tree(X, g, h, inc, fok, iscat, nb, mc, db, spec, comm)
+
+        grow = self.pctx.shard_grow(grow_fn)
 
         def step(score, valid_scores, bag_mask, key, it, shrinkage, *grads):
             if custom_grads:
@@ -229,14 +277,16 @@ class GBDT:
                 if self.use_feature_fraction:
                     fk = jax.random.fold_in(fkey, k)
                     noise = jax.random.uniform(fk, (spec.num_features,))
+                    # padding features must not consume sample slots
+                    noise = jnp.where(self.feature_ok_base, noise, -1.0)
                     _, top_idx = jax.lax.top_k(noise, self.n_feature_sample)
                     fmask = jnp.zeros(spec.num_features, bool).at[top_idx].set(True)
                     fmask = fmask & self.feature_ok_base
                 else:
                     fmask = self.feature_ok_base
-                tree, leaf_ids = grow_tree(
-                    self.Xb, g[k] * mask, h[k] * mask, mask, fmask,
-                    self.num_bins, self.missing_code, self.default_bin, spec)
+                tree, leaf_ids = grow(
+                    self.Xb, g[k] * mask, h[k] * mask, mask, fmask, self.is_cat,
+                    self.num_bins, self.missing_code, self.default_bin)
                 tree = tree._replace(leaf_value=tree.leaf_value * shrinkage)
                 tree = self._tree_output_transform(tree)
                 new_scores.append(self._score_update(score[k], tree.leaf_value[leaf_ids], it))

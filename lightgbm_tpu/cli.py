@@ -1,0 +1,136 @@
+"""Command-line application driver.
+
+Reference counterpart: src/application/application.cpp + src/main.cpp — the
+`task=train|predict|convert_model` dispatcher driven by `key=value` argv
+pairs and a `config=<file>` conf file (`key = value` lines, `#` comments),
+compatible with the reference's example configs
+(examples/*/train.conf, predict.conf).
+
+Usage:  python -m lightgbm_tpu config=train.conf [key=value ...]
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config
+from .engine import train as train_fn
+from .io.file_io import load_data_file
+from .utils.log import Log
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    """argv `key=value` pairs + conf file merge; argv wins on conflict
+    (reference Application::LoadParameters, application.cpp:48-81)."""
+    cli: Dict[str, str] = {}
+    for tok in argv:
+        tok = tok.strip()
+        if not tok or tok.startswith("#"):
+            continue
+        if "=" not in tok:
+            Log.warning("Unknown argument %s (expected key=value)", tok)
+            continue
+        k, v = tok.split("=", 1)
+        cli[k.strip()] = v.strip().strip('"')
+
+    params: Dict[str, str] = {}
+    conf_path = cli.get("config", cli.get("config_file", ""))
+    if conf_path:
+        with open(conf_path) as fh:
+            for line in fh:
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                params[k.strip()] = v.strip().strip('"')
+    params.update(cli)                  # argv has higher priority (:76-80)
+    params.pop("config", None)
+    params.pop("config_file", None)
+    return params
+
+
+def _load_dataset(path: str, params: Dict, config: Config,
+                  reference: Optional[Dataset] = None) -> Dataset:
+    X, label, side = load_data_file(path, params)
+    if reference is not None:
+        ds = reference.create_valid(X, label=label)
+    else:
+        ds = Dataset(X, label=label, feature_name=side.get("feature_names"))
+    if side.get("weight") is not None:
+        ds.set_weight(side["weight"])
+    if side.get("group") is not None:
+        ds.set_group(side["group"].astype(np.int64))
+    if side.get("init_score") is not None:
+        ds.set_init_score(side["init_score"])
+    return ds
+
+
+def run_train(params: Dict) -> None:
+    config = Config.from_params(params)
+    if not config.data:
+        Log.fatal("No training data specified (data=...)")
+    train_set = _load_dataset(config.data, params, config)
+    valid_sets, valid_names = [], []
+    for i, vf in enumerate(config.valid_data):
+        valid_sets.append(_load_dataset(vf, params, config, reference=train_set))
+        valid_names.append(f"valid_{i + 1}" if len(config.valid_data) > 1 else "valid_1")
+    booster = train_fn(params, train_set,
+                       num_boost_round=config.num_iterations,
+                       valid_sets=valid_sets, valid_names=valid_names,
+                       early_stopping_rounds=config.early_stopping_round or None)
+    booster.save_model(config.output_model)
+    Log.info("Finished training, model saved to %s", config.output_model)
+
+
+def run_predict(params: Dict) -> None:
+    config = Config.from_params(params)
+    if not config.input_model:
+        Log.fatal("No input model specified for prediction (input_model=...)")
+    if not config.data:
+        Log.fatal("No prediction data specified (data=...)")
+    booster = Booster(params=params, model_file=config.input_model)
+    X, _, _ = load_data_file(config.data, params)
+    niter = config.num_iteration_predict if config.num_iteration_predict > 0 else None
+    preds = booster.predict(
+        X, num_iteration=niter,
+        raw_score=config.is_predict_raw_score,
+        pred_leaf=config.is_predict_leaf_index,
+        pred_contrib=config.is_predict_contrib)
+    preds = np.atleast_2d(preds.T).T if preds.ndim == 1 else preds
+    with open(config.output_result, "w") as fh:
+        for row in (preds if preds.ndim == 2 else preds[:, None]):
+            fh.write("\t".join(f"{v:.18g}" for v in np.atleast_1d(row)) + "\n")
+    Log.info("Finished prediction, results saved to %s", config.output_result)
+
+
+def run_convert_model(params: Dict) -> None:
+    config = Config.from_params(params)
+    if not config.input_model:
+        Log.fatal("No input model specified (input_model=...)")
+    booster = Booster(params=params, model_file=config.input_model)
+    from .io.codegen import model_to_cpp
+    with open(config.convert_model, "w") as fh:
+        fh.write(model_to_cpp(booster))
+    Log.info("Model converted to C++ at %s", config.convert_model)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    params = parse_args(argv)
+    task = params.get("task", "train")
+    if task == "train" or task == "refit":
+        run_train(params)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(params)
+    elif task in ("convert_model", "convert"):
+        run_convert_model(params)
+    else:
+        Log.fatal("Unknown task %s", task)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,11 @@ TPU re-architecture of SerialTreeLearner::Train
 Everything is fixed-shape; "no split this wave" is a masked no-op, so the
 whole tree trains in one XLA dispatch with zero host round-trips (the axon
 tunnel costs ~67ms per sync — exp/RESULTS.md).
+
+Distributed growth (reference src/treelearner/*parallel*) plugs in through a
+``comm`` strategy object (parallel/comm.py): histogram reduction, scalar
+psums, and best-split sync happen at exactly the reference's three collective
+call sites, but as XLA collectives inside the same while_loop.
 """
 from __future__ import annotations
 
@@ -35,8 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .ops.histogram import build_histograms, root_sums
-from .ops.split_finder import (SplitCandidates, find_best_splits_numerical,
-                               leaf_output)
+from .ops.split_finder import SplitCandidates, leaf_output
 
 NEG_INF = -jnp.inf
 
@@ -51,6 +55,8 @@ class TreeArrays(NamedTuple):
     split_feature: jnp.ndarray    # i32 [M+1] inner feature index
     threshold_bin: jnp.ndarray    # i32 [M+1]
     default_left: jnp.ndarray     # bool [M+1]
+    is_cat: jnp.ndarray           # bool [M+1] categorical split
+    cat_mask: jnp.ndarray         # bool [M+1, B] left-set over bins (cat)
     left_child: jnp.ndarray       # i32 [M+1]
     right_child: jnp.ndarray      # i32 [M+1]
     split_gain: jnp.ndarray       # f32 [M+1]
@@ -83,7 +89,7 @@ class GrowState(NamedTuple):
 class GrowerSpec:
     """Static (trace-time) configuration of the grower."""
     num_leaves: int
-    num_features: int
+    num_features: int             # width of X (histogram-build features)
     num_bins_padded: int
     chunk_rows: int
     hist_slots: int               # leaves histogrammed per pass == max splits/wave
@@ -94,14 +100,41 @@ class GrowerSpec:
     min_data_in_leaf: float
     min_sum_hessian_in_leaf: float
     min_gain_to_split: float
+    num_block_features: int = 0   # features this device SCANS (0 = num_features);
+                                  # < num_features under data-parallel psum_scatter
+    # categorical split search (reference config.h:230-234)
+    use_categorical: bool = False
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+    min_data_per_group: float = 100.0
+
+    @property
+    def block_features(self) -> int:
+        return self.num_block_features or self.num_features
+
+    def hyperparams(self) -> Dict[str, float]:
+        return dict(lambda_l1=self.lambda_l1, lambda_l2=self.lambda_l2,
+                    min_data_in_leaf=self.min_data_in_leaf,
+                    min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
+                    min_gain_to_split=self.min_gain_to_split)
+
+    def cat_hyperparams(self) -> Dict[str, float]:
+        return dict(cat_smooth=self.cat_smooth, cat_l2=self.cat_l2,
+                    max_cat_threshold=self.max_cat_threshold,
+                    max_cat_to_onehot=self.max_cat_to_onehot,
+                    min_data_per_group=self.min_data_per_group)
 
 
-def _empty_tree(L: int) -> TreeArrays:
+def _empty_tree(L: int, B: int) -> TreeArrays:
     M = L - 1
     return TreeArrays(
         split_feature=jnp.zeros(M + 1, jnp.int32),
         threshold_bin=jnp.zeros(M + 1, jnp.int32),
         default_left=jnp.zeros(M + 1, bool),
+        is_cat=jnp.zeros(M + 1, bool),
+        cat_mask=jnp.zeros((M + 1, B), bool),
         left_child=jnp.full(M + 1, -1, jnp.int32),
         right_child=jnp.full(M + 1, -1, jnp.int32),
         split_gain=jnp.zeros(M + 1, jnp.float32),
@@ -114,7 +147,7 @@ def _empty_tree(L: int) -> TreeArrays:
     )
 
 
-def _empty_cand(L: int) -> SplitCandidates:
+def _empty_cand(L: int, B: int) -> SplitCandidates:
     return SplitCandidates(
         gain=jnp.full(L + 1, NEG_INF, jnp.float32),
         feature=jnp.zeros(L + 1, jnp.int32),
@@ -123,6 +156,8 @@ def _empty_cand(L: int) -> SplitCandidates:
         left_g=jnp.zeros(L + 1, jnp.float32),
         left_h=jnp.zeros(L + 1, jnp.float32),
         left_c=jnp.zeros(L + 1, jnp.float32),
+        is_cat=jnp.zeros(L + 1, bool),
+        cat_mask=jnp.zeros((L + 1, B), bool),
     )
 
 
@@ -132,22 +167,35 @@ def grow_tree(
     hess: jnp.ndarray,            # [N] f32
     included: jnp.ndarray,        # [N] f32 0/1
     feature_ok: jnp.ndarray,      # [F] bool: feature_fraction mask & non-trivial
+    is_cat: jnp.ndarray,          # [F] bool: categorical feature
     num_bins: jnp.ndarray,        # [F] i32
     missing_code: jnp.ndarray,    # [F] i32
     default_bin: jnp.ndarray,     # [F] i32
     spec: GrowerSpec,
+    comm=None,
 ) -> Tuple[TreeArrays, jnp.ndarray]:
-    """Grow one tree; returns (tree arrays, final leaf_id per row)."""
+    """Grow one tree; returns (tree arrays, final leaf_id per row).
+
+    With a distributed ``comm`` (parallel/comm.py) this body runs inside
+    shard_map: X/grad/hess/leaf_id may be row-local shards, the histogram
+    cache covers only this device's feature block, and split candidates are
+    globally synced — the tree arrays stay replicated on every device.
+    """
+    if comm is None:
+        from .parallel.comm import SerialComm
+        comm = SerialComm(spec.num_features)
     L = spec.num_leaves
     M = L - 1
     S = spec.hist_slots
-    F = spec.num_features
+    F = spec.block_features       # features scanned/cached on this device
     B = spec.num_bins_padded
     N = X.shape[0]
+    X_hist = comm.hist_X(X)       # columns this device histograms
+    bm = comm.block_meta(feature_ok, num_bins, missing_code, default_bin, is_cat)
 
-    rg, rh, rc = root_sums(grad, hess, included)
+    rg, rh, rc = comm.reduce_scalars(*root_sums(grad, hess, included))
 
-    tree = _empty_tree(L)
+    tree = _empty_tree(L, B)
     state = GrowState(
         tree=tree,
         leaf_id=jnp.zeros(N, jnp.int32),
@@ -157,7 +205,7 @@ def grow_tree(
         cnt=jnp.zeros(L + 1, jnp.float32).at[0].set(rc),
         leaf_depth=jnp.zeros(L + 1, jnp.int32),
         leaf_is_right=jnp.zeros(L + 1, bool),
-        cand=_empty_cand(L),
+        cand=_empty_cand(L, B),
         needs_hist=jnp.zeros(L + 1, bool).at[0].set(True),
         sib_leaf=jnp.full(L + 1, L, jnp.int32),
         parent_cache=jnp.full(L + 1, L, jnp.int32),
@@ -178,9 +226,13 @@ def grow_tree(
         ].set(leaf_iota, mode="drop")
 
         # ---- 2. one masked pass builds S histograms ------------------------
+        # then the distributed reduction: psum_scatter for data-parallel
+        # (reference data_parallel_tree_learner.cpp:148-163), identity
+        # otherwise; output covers this device's feature block only.
         new_hist = build_histograms(
-            X, grad, hess, included, state.leaf_id, slot_of_leaf,
+            X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
             num_slots=S, num_bins_padded=B, chunk_rows=spec.chunk_rows)
+        new_hist = comm.reduce_hist(new_hist)
 
         # ---- 3. cache write + sibling by subtraction -----------------------
         slot_valid = leaf_of_slot < L
@@ -195,14 +247,13 @@ def grow_tree(
         # ---- 4. split scan for the 2S touched leaves -----------------------
         scan_leaves = jnp.concatenate([leaf_of_slot, jnp.where(slot_valid, sibs, L)])
         scan_hist = jnp.concatenate([new_hist, sib_hist], axis=0)  # [2S, F, B, 3]
-        cand_new = find_best_splits_numerical(
+        # candidate features are GLOBAL indices; under feature/data
+        # parallelism this ends in an all-gather argmax across devices
+        # (reference SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207)
+        cand_new = comm.find_splits(
             scan_hist,
             state.sum_g[scan_leaves], state.sum_h[scan_leaves], state.cnt[scan_leaves],
-            num_bins, missing_code, default_bin, feature_ok,
-            lambda_l1=spec.lambda_l1, lambda_l2=spec.lambda_l2,
-            min_data_in_leaf=spec.min_data_in_leaf,
-            min_sum_hessian_in_leaf=spec.min_sum_hessian_in_leaf,
-            min_gain_to_split=spec.min_gain_to_split)
+            bm, spec)
         cand = SplitCandidates(*[
             old.at[scan_leaves].set(new) for old, new in zip(state.cand, cand_new)])
         cand = cand._replace(gain=cand.gain.at[L].set(NEG_INF))  # keep scratch row inert
@@ -235,6 +286,8 @@ def grow_tree(
             split_feature=t.split_feature.at[nid].set(cand.feature[p]),
             threshold_bin=t.threshold_bin.at[nid].set(cand.threshold[p]),
             default_left=t.default_left.at[nid].set(cand.default_left[p]),
+            is_cat=t.is_cat.at[nid].set(cand.is_cat[p]),
+            cat_mask=t.cat_mask.at[nid].set(cand.cat_mask[p]),
             split_gain=t.split_gain.at[nid].set(cand.gain[p]),
             internal_value=t.internal_value.at[nid].set(
                 leaf_output(pg, ph, spec.lambda_l1, spec.lambda_l2)),
@@ -265,11 +318,7 @@ def grow_tree(
         cnt = state.cnt.at[p].set(lc).at[q].set(rc_)
         new_depth = state.leaf_depth[p] + 1
         leaf_depth = state.leaf_depth.at[p].set(new_depth).at[q].set(new_depth)
-        cand = SplitCandidates(
-            gain=cand.gain.at[p].set(NEG_INF).at[q].set(NEG_INF),
-            feature=cand.feature, threshold=cand.threshold,
-            default_left=cand.default_left, left_g=cand.left_g,
-            left_h=cand.left_h, left_c=cand.left_c)
+        cand = cand._replace(gain=cand.gain.at[p].set(NEG_INF).at[q].set(NEG_INF))
 
         # next wave: histogram the smaller child, derive the larger (ref
         # serial_tree_learner.cpp:354-362)
@@ -297,6 +346,15 @@ def grow_tree(
         dbin = default_bin[f_safe]
         is_missing = ((mcode == 2) & (x_bin == nbin - 1)) | ((mcode == 1) & (x_bin == dbin))
         go_left = jnp.where(is_missing, map_dl[lid], x_bin <= map_thr[lid])
+        if spec.use_categorical:
+            # categorical routing: bin in the split's left-set -> left
+            # (reference Tree::CategoricalDecision, tree.h:257-284)
+            map_iscat = jnp.zeros(L + 1, bool).at[p].set(cand.is_cat[p], mode="drop")
+            map_mask = jnp.zeros((L + 1, B), bool).at[p].set(cand.cat_mask[p],
+                                                            mode="drop")
+            go_left_cat = jnp.take_along_axis(map_mask[lid], x_bin[:, None],
+                                              axis=1)[:, 0]
+            go_left = jnp.where(map_iscat[lid], go_left_cat, go_left)
         leaf_id = jnp.where((f_row >= 0), jnp.where(go_left, lid, map_right[lid]), lid)
 
         done = (n_apply == 0) | (state.num_leaves_cur + n_apply >= L)

@@ -141,12 +141,20 @@ def _parse_tree_block(lines: Dict[str, str]) -> Tree:
             return np.zeros(n)
         return np.array([float(t) for t in lines[key].split()], dtype=np.float64)[:n]
 
+    thresholds = floats("threshold", M)
+    decision_types = ints("decision_type", M).astype(np.uint8)
+    # for categorical nodes `threshold` holds the cat_boundaries index
+    # (reference tree.cpp ToString); keep it addressable via threshold_bin.
+    # Numerical thresholds may be inf (top bin) — cast only cat nodes.
+    is_cat_node = (decision_types & 1).astype(bool)
+    threshold_bin = np.zeros(M, dtype=np.int32)
+    threshold_bin[is_cat_node] = thresholds[is_cat_node].astype(np.int32)
     tree = Tree(
         num_leaves=num_leaves,
         split_feature=ints("split_feature", M).astype(np.int32),
-        threshold_bin=np.zeros(M, dtype=np.int32),
-        threshold=floats("threshold", M),
-        decision_type=ints("decision_type", M).astype(np.uint8),
+        threshold_bin=threshold_bin,
+        threshold=thresholds,
+        decision_type=decision_types,
         left_child=ints("left_child", M).astype(np.int32),
         right_child=ints("right_child", M).astype(np.int32),
         split_gain=floats("split_gain", M),

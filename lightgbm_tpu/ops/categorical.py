@@ -1,0 +1,203 @@
+"""Categorical best-split search over histograms (device-side).
+
+TPU re-formulation of FeatureHistogram::FindBestThresholdCategorical
+(reference: src/treelearner/feature_histogram.hpp:104-259). Two modes, chosen
+per feature by ``num_bin <= max_cat_to_onehot``:
+
+- **one-hot**: every category is a candidate singleton left-set; fully
+  vectorized gain over (slot, feature, bin).
+- **sorted prefix (many categories)**: categories with count >= cat_smooth
+  are sorted by gradient/hessian ratio ``sum_g / (sum_h + cat_smooth)``
+  (:163-172); candidate left-sets are prefixes of that order from both ends
+  (dir=+1 from smallest ctr, dir=-1 from largest), at most
+  ``min(max_cat_threshold, (used+1)/2)`` categories (:180); ``cat_l2`` is
+  added to lambda_l2 (:161); ``min_data_per_group`` gates evaluation on the
+  count accumulated since the last evaluated prefix (:185-210) — a stateful
+  rule kept exact here via a short `lax.scan` over prefix positions
+  (max_cat_threshold is 32 by default, so the scan is tiny).
+
+The winning left-set is returned as a per-(slot) boolean mask over bins —
+the device analog of the reference's ``cat_threshold`` bitset
+(split_info.hpp, tree.h:257-284); the grower routes rows by mask lookup and
+the host finalize converts masks to raw-category bitsets.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .split_finder import PerFeatureBest, leaf_split_gain
+
+NEG_INF = -jnp.inf
+K_EPS = 1e-15                     # kEpsilon (reference meta.h)
+
+
+def per_feature_best_categorical(
+    hist: jnp.ndarray,            # [S, F, B, 3] (sum_g, sum_h, count)
+    parent_g: jnp.ndarray,        # [S]
+    parent_h: jnp.ndarray,        # [S]
+    parent_c: jnp.ndarray,        # [S]
+    num_bins: jnp.ndarray,        # [F] i32
+    missing_code: jnp.ndarray,    # [F] i32 (0=none, 1=zero, 2=nan)
+    cat_ok: jnp.ndarray,          # [F] bool: categorical & usable this tree
+    *,
+    lambda_l1: float,
+    lambda_l2: float,
+    min_data_in_leaf: float,
+    min_sum_hessian_in_leaf: float,
+    min_gain_to_split: float,
+    cat_smooth: float,
+    cat_l2: float,
+    max_cat_threshold: int,
+    max_cat_to_onehot: int,
+    min_data_per_group: float,
+) -> Tuple[PerFeatureBest, jnp.ndarray]:
+    """Best categorical split per (slot, feature) + left-set mask [S, F, B]."""
+    S, F, B, _ = hist.shape
+    g = hist[..., 0]
+    h = hist[..., 1]
+    c = hist[..., 2]
+    bins = jnp.arange(B, dtype=jnp.int32)[None, None, :]            # [1,1,B]
+    # used_bin = num_bin - 1 + (missing_type == None): the trailing bin is the
+    # NaN/overflow bin unless the feature is fully categorical (:114-115)
+    used_bin = num_bins + jnp.where(missing_code == 0, 0, -1)       # [F]
+    in_range = bins < used_bin[None, :, None]                       # [1,F,B]
+
+    mdl = min_data_in_leaf
+    msh = min_sum_hessian_in_leaf
+    pg = parent_g[:, None, None]
+    ph = parent_h[:, None, None]
+    pc = parent_c[:, None, None]
+    min_gain_shift = (leaf_split_gain(parent_g, parent_h, lambda_l1, lambda_l2)
+                      + min_gain_to_split)                          # [S]
+
+    # ---------------- one-hot mode (:122-155) ------------------------------
+    oh_lh = h + K_EPS
+    oh_rg, oh_rh, oh_rc = pg - g, ph - oh_lh, pc - c
+    oh_ok = (in_range & (c >= mdl) & (oh_rc >= mdl)
+             & (h >= msh) & (oh_rh >= msh))
+    oh_gain = (leaf_split_gain(g, oh_lh, lambda_l1, lambda_l2)
+               + leaf_split_gain(oh_rg, oh_rh, lambda_l1, lambda_l2))
+    oh_gain = jnp.where(oh_ok, oh_gain, NEG_INF)                    # [S,F,B]
+    oh_best = jnp.argmax(oh_gain, axis=2)                           # [S,F]
+    oh_best_gain = jnp.take_along_axis(oh_gain, oh_best[..., None], axis=2)[..., 0]
+
+    # ---------------- sorted-prefix mode (:156-231) ------------------------
+    l2s = lambda_l2 + cat_l2
+    valid = in_range & (c >= cat_smooth)                            # [S,F,B]
+    ctr = g / (h + cat_smooth)
+    sort_key = jnp.where(valid, ctr, jnp.inf)
+    order = jnp.argsort(sort_key, axis=2)                           # [S,F,B]
+    rank = jnp.argsort(order, axis=2)                               # bin -> position
+    vmask = jnp.take_along_axis(valid, order, axis=2).astype(jnp.float32)
+    sg = jnp.take_along_axis(g, order, axis=2) * vmask
+    sh = jnp.take_along_axis(h, order, axis=2) * vmask
+    sc = jnp.take_along_axis(c, order, axis=2) * vmask
+    cum_g = jnp.cumsum(sg, axis=2)
+    cum_h = jnp.cumsum(sh, axis=2)
+    cum_c = jnp.cumsum(sc, axis=2)
+    tot_g, tot_h, tot_c = cum_g[..., -1], cum_h[..., -1], cum_c[..., -1]
+    used_cnt = jnp.sum(valid, axis=2).astype(jnp.int32)             # [S,F]
+    max_num_cat = jnp.minimum(max_cat_threshold, (used_cnt + 1) // 2)
+
+    n_scan = max(1, min(int(max_cat_threshold), B))
+
+    def prefix(i):
+        """Left sums after taking i+1 categories, for both directions.
+        dir 0 = +1 (from smallest ctr), dir 1 = -1 (from largest).
+        ``i`` is a traced scan counter with i < n_scan <= B."""
+        at = lambda a, idx: jax.lax.dynamic_index_in_dim(a, idx, axis=2,
+                                                         keepdims=False)
+        fwd = (at(cum_g, i), at(cum_h, i), at(cum_c, i))
+        j = jnp.clip(used_cnt - 2 - i, -1, B - 1)                   # [S,F]
+        take = lambda a: jnp.where(
+            j < 0, 0.0, jnp.take_along_axis(a, jnp.maximum(j, 0)[..., None],
+                                            axis=2)[..., 0])
+        rev = (tot_g - take(cum_g), tot_h - take(cum_h), tot_c - take(cum_c))
+        lg = jnp.stack([fwd[0], rev[0]])                            # [2,S,F]
+        lh = jnp.stack([fwd[1], rev[1]])
+        lc = jnp.stack([fwd[2], rev[2]])
+        # count of the single category taken at step i per direction
+        cnt_i_fwd = at(sc, i)
+        jj = jnp.clip(used_cnt - 1 - i, 0, B - 1)
+        cnt_i_rev = jnp.take_along_axis(sc, jj[..., None], axis=2)[..., 0]
+        return lg, lh, lc, jnp.stack([cnt_i_fwd, cnt_i_rev])
+
+    def scan_body(carry, i):
+        ccg, broke, best_gain, best_k = carry                        # [2,S,F] each
+        lg, lh, lc, cnt_i = prefix(i)
+        lh_eps = lh + K_EPS
+        step_ok = (i < max_num_cat) & (i < used_cnt)                 # [S,F]
+        ccg = ccg + cnt_i
+        cont1 = (lc < mdl) | (lh_eps < msh)                          # :195-196 continue
+        rc = pc[..., 0] - lc
+        rh = ph[..., 0] - lh_eps
+        brk = (~cont1) & ((rc < mdl) | (rc < min_data_per_group)     # :198-201 break
+                          | (rh < msh))
+        broke = broke | (step_ok[None] & brk)
+        can_eval = step_ok[None] & ~broke & ~cont1 & (ccg >= min_data_per_group)
+        ccg = jnp.where(can_eval, 0.0, ccg)                          # :205-207
+        gain_i = (leaf_split_gain(lg, lh_eps, lambda_l1, l2s)
+                  + leaf_split_gain(pg[..., 0] - lg, ph[..., 0] - lh_eps,
+                                    lambda_l1, l2s))
+        better = can_eval & (gain_i > min_gain_shift[None, :, None]) \
+            & (gain_i > best_gain)
+        best_gain = jnp.where(better, gain_i, best_gain)
+        best_k = jnp.where(better, i, best_k)
+        return (ccg, broke, best_gain, best_k), None
+
+    init = (jnp.zeros((2, S, F)), jnp.zeros((2, S, F), bool),
+            jnp.full((2, S, F), NEG_INF), jnp.zeros((2, S, F), jnp.int32))
+    (_, _, sp_gain, sp_k), _ = jax.lax.scan(
+        scan_body, init, jnp.arange(n_scan, dtype=jnp.int32))
+
+    # pick direction (dir=+1 wins ties: argmax picks the first)
+    sp_dir = jnp.argmax(sp_gain, axis=0)                             # [S,F]
+    sp_best_gain = jnp.take_along_axis(sp_gain, sp_dir[None], axis=0)[0]
+    sp_best_k = jnp.take_along_axis(sp_k, sp_dir[None], axis=0)[0]   # [S,F]
+
+    # ---------------- merge modes + build outputs --------------------------
+    use_onehot = (num_bins <= max_cat_to_onehot)[None, :]            # [1,F]
+    raw_gain = jnp.where(use_onehot, oh_best_gain, sp_best_gain)
+    gate = cat_ok[None, :]
+    gain = jnp.where(gate & (raw_gain > min_gain_shift[:, None]),
+                     raw_gain - min_gain_shift[:, None], NEG_INF)    # [S,F]
+
+    # left-set mask over bins
+    oh_mask = bins == oh_best[..., None]                             # [S,F,B]
+    is_fwd = (sp_dir == 0)[..., None]
+    sp_mask = jnp.where(
+        is_fwd, rank <= sp_best_k[..., None],
+        rank >= (used_cnt - 1 - sp_best_k)[..., None]) & valid
+    mask = jnp.where(use_onehot[..., None], oh_mask, sp_mask)
+    mask = mask & (gain > NEG_INF)[..., None]
+
+    # left sums of the winner
+    def sp_left(arr_cum):
+        fwd_v = jnp.take_along_axis(
+            arr_cum, jnp.clip(sp_best_k, 0, B - 1)[..., None], axis=2)[..., 0]
+        j = jnp.clip(used_cnt - 2 - sp_best_k, -1, B - 1)
+        tot = arr_cum[..., -1]
+        rev_v = tot - jnp.where(
+            j < 0, 0.0, jnp.take_along_axis(arr_cum, jnp.maximum(j, 0)[..., None],
+                                            axis=2)[..., 0])
+        return jnp.where(is_fwd[..., 0], fwd_v, rev_v)
+
+    oh_lg = jnp.take_along_axis(g, oh_best[..., None], axis=2)[..., 0]
+    oh_lh2 = jnp.take_along_axis(h, oh_best[..., None], axis=2)[..., 0]
+    oh_lc = jnp.take_along_axis(c, oh_best[..., None], axis=2)[..., 0]
+    left_g = jnp.where(use_onehot, oh_lg, sp_left(cum_g))
+    left_h = jnp.where(use_onehot, oh_lh2, sp_left(cum_h))
+    left_c = jnp.where(use_onehot, oh_lc, sp_left(cum_c))
+
+    pf = PerFeatureBest(
+        gain=gain,
+        threshold=jnp.zeros((S, F), jnp.int32),
+        default_left=jnp.zeros((S, F), bool),                        # :105
+        left_g=left_g,
+        left_h=left_h,
+        left_c=left_c,
+    )
+    return pf, mask

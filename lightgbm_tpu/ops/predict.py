@@ -49,6 +49,10 @@ def leaves_from_binned(
         dbin = default_bin[f]
         is_missing = ((mcode == 2) & (b == nbin - 1)) | ((mcode == 1) & (b == dbin))
         go_left = jnp.where(is_missing, dl, b <= thr)
+        # categorical: bin-in-left-set lookup (reference tree.h:257-284)
+        go_left_cat = jnp.take_along_axis(tree.cat_mask[nid], b[:, None],
+                                          axis=1)[:, 0]
+        go_left = jnp.where(tree.is_cat[nid], go_left_cat, go_left)
         child = jnp.where(go_left, tree.left_child[nid], tree.right_child[nid])
         cur = jnp.where(at_node, child, cur)
         return cur, steps + 1

@@ -7,6 +7,18 @@ missing-value default-direction learning) become masked cumulative sums over
 the bin axis, evaluated for all (slot, feature, threshold, direction)
 candidates at once, followed by one argmax.
 
+The search is split into two stages so the distributed tree learners
+(parallel/comm.py) can compose them the way the reference composes
+FindBestSplitsFromHistograms with its network reductions:
+
+1. ``per_feature_best_numerical`` — best threshold *per feature*
+   (the reference's per-feature OMP loop, serial_tree_learner.cpp:451-516),
+2. ``reduce_features`` — argmax over the feature axis
+   (the reference's ``best_split_per_leaf_`` update); feature-parallel
+   learners instead all-gather per-device winners and argmax across devices
+   (SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207), voting learners
+   use the per-feature gains for PV-Tree vote collection.
+
 Semantics preserved:
 - gain = GetLeafSplitGain(l) + GetLeafSplitGain(r) with L1 thresholding
   (feature_histogram.hpp:290-296), candidate valid iff
@@ -20,8 +32,9 @@ Semantics preserved:
   (:86-99), with the 2-bin NaN default-direction fix (:96-98),
 - min_data_in_leaf / min_sum_hessian_in_leaf constraints on both children.
 
-Categorical features are handled by find_best_splits_categorical (one-hot and
-sorted-prefix modes, feature_histogram.hpp:104-259).
+Categorical features are handled by ops/categorical.py (one-hot and
+sorted-prefix modes, feature_histogram.hpp:104-259), which produces the same
+``PerFeatureBest`` shape and is merged before ``reduce_features``.
 """
 from __future__ import annotations
 
@@ -34,14 +47,26 @@ NEG_INF = -jnp.inf
 
 
 class SplitCandidates(NamedTuple):
-    """Best split per histogram slot (device arrays, all [S])."""
+    """Best split per histogram slot (device arrays, all [S] unless noted)."""
     gain: jnp.ndarray          # f32, improvement over parent (-inf if none)
-    feature: jnp.ndarray       # i32 inner feature index
+    feature: jnp.ndarray       # i32 inner feature index (GLOBAL)
     threshold: jnp.ndarray     # i32 bin threshold (left: bin <= threshold)
     default_left: jnp.ndarray  # bool
     left_g: jnp.ndarray        # f32 sum of gradients in left child
     left_h: jnp.ndarray        # f32
     left_c: jnp.ndarray        # f32 row count in left child
+    is_cat: jnp.ndarray        # bool: categorical split
+    cat_mask: jnp.ndarray      # bool [S, B]: left-set over bins (cat splits)
+
+
+class PerFeatureBest(NamedTuple):
+    """Best split per (slot, feature); all arrays [S, F]."""
+    gain: jnp.ndarray          # f32, improvement over parent (-inf if none)
+    threshold: jnp.ndarray     # i32
+    default_left: jnp.ndarray  # bool
+    left_g: jnp.ndarray
+    left_h: jnp.ndarray
+    left_c: jnp.ndarray
 
 
 def leaf_split_gain(sum_g, sum_h, l1: float, l2: float):
@@ -56,7 +81,7 @@ def leaf_output(sum_g, sum_h, l1: float, l2: float):
     return -jnp.sign(sum_g) * reg / (sum_h + l2)
 
 
-def find_best_splits_numerical(
+def per_feature_best_numerical(
     hist: jnp.ndarray,        # [S, F, B, 3] (sum_g, sum_h, count)
     parent_g: jnp.ndarray,    # [S]
     parent_h: jnp.ndarray,    # [S]
@@ -64,14 +89,19 @@ def find_best_splits_numerical(
     num_bins: jnp.ndarray,    # [F] i32
     missing_code: jnp.ndarray,  # [F] i32: 0=none, 1=zero, 2=nan
     default_bin: jnp.ndarray,   # [F] i32
-    feature_ok: jnp.ndarray,    # [F] bool (non-categorical & feature_fraction mask)
+    feature_ok: jnp.ndarray,    # [F] bool (numerical & feature_fraction mask)
     *,
     lambda_l1: float,
     lambda_l2: float,
     min_data_in_leaf: float,
     min_sum_hessian_in_leaf: float,
     min_gain_to_split: float,
-) -> SplitCandidates:
+) -> PerFeatureBest:
+    """Best numerical threshold for every (slot, feature) pair.
+
+    Gains are already shifted by the parent gain + min_gain_to_split
+    (feature_histogram.hpp:101), so a finite value means "valid improvement".
+    """
     S, F, B, _ = hist.shape
     g = hist[..., 0]
     h = hist[..., 1]
@@ -136,31 +166,74 @@ def find_best_splits_numerical(
     rev_gain = jnp.where(rev_gain > parent_gain_shift, rev_gain - parent_gain_shift, NEG_INF)
     fwd_gain = jnp.where(fwd_gain > parent_gain_shift, fwd_gain - parent_gain_shift, NEG_INF)
 
-    # --- pick best over (dir, feature, threshold); rev first to mirror the
+    # --- per feature: pick best over (dir, threshold); rev first to mirror the
     # reference's dir=-1-then-dir=+1 strict-improvement ordering (:89-93)
-    all_gain = jnp.stack([rev_gain, fwd_gain], axis=1)              # [S, 2, F, B]
-    flat = all_gain.reshape(S, 2 * F * B)
-    best_idx = jnp.argmax(flat, axis=1)
-    best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
-    d_idx = best_idx // (F * B)
-    f_idx = (best_idx // B) % F
-    t_idx = best_idx % B
+    dir_gain = jnp.stack([rev_gain, fwd_gain], axis=2)              # [S, F, 2, B]
+    flat = dir_gain.reshape(S, F, 2 * B)
+    best_idx = jnp.argmax(flat, axis=2)                             # [S, F]
+    best_gain = jnp.take_along_axis(flat, best_idx[..., None], axis=2)[..., 0]
+    is_rev = best_idx < B
+    t_idx = (best_idx % B).astype(jnp.int32)
 
-    def gather(arr):  # arr [S, F, B] -> [S] at (f_idx, t_idx)
-        return arr[jnp.arange(S), f_idx, t_idx]
+    def pick(rev_arr, fwd_arr):  # [S, F, B] -> [S, F] at t_idx per direction
+        r = jnp.take_along_axis(rev_arr, t_idx[..., None], axis=2)[..., 0]
+        f = jnp.take_along_axis(fwd_arr, t_idx[..., None], axis=2)[..., 0]
+        return jnp.where(is_rev, r, f)
 
-    is_rev = d_idx == 0
-    left_g = jnp.where(is_rev, gather(rev_lg), gather(fwd_lg))
-    left_h = jnp.where(is_rev, gather(rev_lh), gather(fwd_lh))
-    left_c = jnp.where(is_rev, gather(rev_lc), gather(fwd_lc))
-    default_left = jnp.where(is_rev, rev_default_left[f_idx], False)
+    return PerFeatureBest(
+        gain=best_gain,
+        threshold=t_idx,
+        default_left=jnp.where(is_rev, rev_default_left[None, :], False),
+        left_g=pick(rev_lg, fwd_lg),
+        left_h=pick(rev_lh, fwd_lh),
+        left_c=pick(rev_lc, fwd_lc),
+    )
+
+
+def reduce_features(pf: PerFeatureBest, feature_offset=0, is_cat=None,
+                    cat_mask=None, num_bins_padded: int = 0) -> SplitCandidates:
+    """Argmax over the feature axis -> one candidate per slot.
+
+    ``feature_offset`` maps local feature indices to global ones when the
+    caller holds only a feature shard (parallel/comm.py feature-parallel
+    learner; reference feature_parallel_tree_learner.cpp:31-50).
+    ``is_cat`` [F] / ``cat_mask`` [S, F, B] carry categorical left-sets
+    (ops/categorical.py) through to the winner.
+    """
+    S, F = pf.gain.shape
+    f_idx = jnp.argmax(pf.gain, axis=1)                             # [S]
+    srange = jnp.arange(S)
+
+    def gather(arr):
+        return arr[srange, f_idx]
+
+    if is_cat is None:
+        B = num_bins_padded or 1
+        win_cat = jnp.zeros(S, bool)
+        win_mask = jnp.zeros((S, B), bool)
+    else:
+        win_cat = is_cat[f_idx]
+        win_mask = cat_mask[srange, f_idx]                          # [S, B]
 
     return SplitCandidates(
-        gain=best_gain,
-        feature=f_idx.astype(jnp.int32),
-        threshold=t_idx.astype(jnp.int32),
-        default_left=default_left,
-        left_g=left_g,
-        left_h=left_h,
-        left_c=left_c,
+        gain=gather(pf.gain),
+        feature=(f_idx + feature_offset).astype(jnp.int32),
+        threshold=gather(pf.threshold).astype(jnp.int32),
+        default_left=gather(pf.default_left),
+        left_g=gather(pf.left_g),
+        left_h=gather(pf.left_h),
+        left_c=gather(pf.left_c),
+        is_cat=win_cat,
+        cat_mask=win_mask,
     )
+
+
+def find_best_splits_numerical(
+    hist, parent_g, parent_h, parent_c, num_bins, missing_code, default_bin,
+    feature_ok, **kwargs,
+) -> SplitCandidates:
+    """Single-shard numerical-only best split per slot (test/bench path)."""
+    pf = per_feature_best_numerical(
+        hist, parent_g, parent_h, parent_c, num_bins, missing_code,
+        default_bin, feature_ok, **kwargs)
+    return reduce_features(pf, num_bins_padded=hist.shape[2])

@@ -1,0 +1,19 @@
+"""Distributed tree learning over a `jax.sharding.Mesh`.
+
+TPU-native replacement for the reference's network stack (src/network/) and
+parallel tree learners (src/treelearner/*parallel*): instead of a hand-built
+TCP/MPI mesh with Bruck all-gather and recursive-halving reduce-scatter
+(network.cpp:44-183), the three collective call sites become XLA collectives
+over ICI/DCN inside one jitted step:
+
+- histogram reduction  -> `jax.lax.psum_scatter` (data-parallel)
+- best-split sync      -> `jax.lax.all_gather` + argmax (all strategies)
+- root sums / scalars  -> `jax.lax.psum`
+"""
+from .comm import (ParallelContext, SerialComm, DataParallelComm,
+                   FeatureParallelComm, VotingParallelComm, make_parallel_context)
+
+__all__ = [
+    "ParallelContext", "SerialComm", "DataParallelComm", "FeatureParallelComm",
+    "VotingParallelComm", "make_parallel_context",
+]

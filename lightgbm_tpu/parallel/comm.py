@@ -1,0 +1,360 @@
+"""Communication strategies for distributed tree growth.
+
+Reference counterparts (all re-expressed as XLA collectives over a mesh axis
+instead of socket/MPI calls — SURVEY.md §2.6):
+
+- ``DataParallelComm``   = DataParallelTreeLearner
+  (src/treelearner/data_parallel_tree_learner.cpp): rows sharded across
+  devices; local histograms for ALL features are `psum_scatter`-reduced so
+  each device owns the globally-summed histograms of one feature block
+  (:148-163), finds best splits on its block, and the global best is an
+  all-gather + argmax (SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207).
+- ``FeatureParallelComm`` = FeatureParallelTreeLearner
+  (src/treelearner/feature_parallel_tree_learner.cpp): every device holds all
+  rows; features are block-partitioned (:31-50); each device histograms only
+  its block and the winner is all-gather + argmax'd. No row sync needed —
+  all devices route rows identically afterwards.
+- ``VotingParallelComm`` = VotingParallelTreeLearner (PV-Tree,
+  src/treelearner/voting_parallel_tree_learner.cpp): rows sharded; each
+  device votes for its local top-k features per leaf (:317-332), votes are
+  summed globally (GlobalVoting :165), and only the ~2k winning features'
+  histogram columns are psum'd (CopyLocalHistogram :197) before the final
+  scan — trading a little accuracy risk for O(k/F) communication.
+
+Each Comm object is a *static* bundle of callables closed over the mesh axis
+name; `grow_tree` (grower.py) calls them at trace time inside `shard_map`.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.categorical import per_feature_best_categorical
+from ..ops.split_finder import (PerFeatureBest, SplitCandidates,
+                                per_feature_best_numerical, reduce_features)
+
+
+class BlockMeta(NamedTuple):
+    """Per-feature metadata of the feature block this device scans.
+
+    Arrays are [F_block]; ``offset`` maps local block index -> global feature
+    index (a traced scalar: axis_index * F_block for sharded strategies).
+    """
+    feature_ok: jnp.ndarray
+    num_bins: jnp.ndarray
+    missing_code: jnp.ndarray
+    default_bin: jnp.ndarray
+    is_cat: jnp.ndarray
+    offset: jnp.ndarray
+
+
+def block_per_feature(hist, pg, ph, pc, bm: BlockMeta, spec):
+    """Best split per (slot, feature) over this block: numerical scan for
+    non-categorical features, categorical one-hot/sorted-prefix for the rest
+    (reference FindBestThreshold dispatch, feature_histogram.hpp:72-104).
+    Returns (PerFeatureBest, cat_mask [S, F, B] or None)."""
+    pf = per_feature_best_numerical(
+        hist, pg, ph, pc, bm.num_bins, bm.missing_code, bm.default_bin,
+        bm.feature_ok & ~bm.is_cat, **spec.hyperparams())
+    if not spec.use_categorical:
+        return pf, None
+    pf_cat, mask = per_feature_best_categorical(
+        hist, pg, ph, pc, bm.num_bins, bm.missing_code,
+        bm.feature_ok & bm.is_cat, **spec.hyperparams(),
+        **spec.cat_hyperparams())
+    merged = PerFeatureBest(*[
+        jnp.where(bm.is_cat[None, :], cv, nv) for nv, cv in zip(pf, pf_cat)])
+    return merged, mask
+
+
+def find_block_splits(hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
+    """Best split per slot over this block's features (feature argmax)."""
+    pf, mask = block_per_feature(hist, pg, ph, pc, bm, spec)
+    if mask is None:
+        return reduce_features(pf, bm.offset, num_bins_padded=hist.shape[2])
+    return reduce_features(pf, bm.offset, is_cat=bm.is_cat, cat_mask=mask)
+
+
+def _gather_argmax(cand: SplitCandidates, axis_name: str) -> SplitCandidates:
+    """Global best split across devices: all-gather candidates, argmax on
+    gain (reference SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207 —
+    there an Allreduce with a custom max-reducer over serialized SplitInfo).
+
+    Ties resolve to the lowest device index; with features block-partitioned
+    contiguously this equals the serial learner's lowest-feature-index rule.
+    """
+    g = jax.lax.all_gather(cand, axis_name)          # leaves [D, S, ...]
+    d_idx = jnp.argmax(g.gain, axis=0)               # [S]
+
+    def pick(arr):
+        idx = d_idx.reshape((1,) + d_idx.shape + (1,) * (arr.ndim - 2))
+        return jnp.take_along_axis(arr, idx, axis=0)[0]
+
+    return jax.tree.map(pick, g)
+
+
+@dataclass(frozen=True)
+class SerialComm:
+    """Single-shard no-op strategy (reference SerialTreeLearner)."""
+    num_features: int = 0            # F_hist == F_block (set by caller)
+
+    def reduce_scalars(self, *xs):
+        return xs
+
+    def hist_X(self, X):
+        """The columns this device histograms (all of them)."""
+        return X
+
+    def reduce_hist(self, hist):
+        """[S, F_hist, B, 3] partial -> [S, F_block, B, 3] global sums."""
+        return hist
+
+    def block_meta(self, feature_ok, num_bins, missing_code, default_bin,
+                   is_cat) -> BlockMeta:
+        return BlockMeta(feature_ok, num_bins, missing_code, default_bin,
+                         is_cat, jnp.asarray(0, jnp.int32))
+
+    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
+        return find_block_splits(hist, pg, ph, pc, bm, spec)
+
+
+def _block_slice(arr, axis_index, block: int):
+    return jax.lax.dynamic_slice_in_dim(arr, axis_index * block, block)
+
+
+@dataclass(frozen=True)
+class DataParallelComm:
+    """Rows sharded on `axis`; histogram psum_scatter over feature blocks."""
+    axis: str
+    num_devices: int
+    num_features: int                # padded: divisible by num_devices
+
+    @property
+    def block(self) -> int:
+        return self.num_features // self.num_devices
+
+    def reduce_scalars(self, *xs):
+        return tuple(jax.lax.psum(x, self.axis) for x in xs)
+
+    def hist_X(self, X):
+        return X                      # all features, local rows
+
+    def reduce_hist(self, hist):
+        # [S, F, B, 3] local sums -> [S, F/D, B, 3] global sums of my block
+        # (reference ReduceScatter of HistogramBinEntry,
+        #  data_parallel_tree_learner.cpp:148-163)
+        S, F, B, C = hist.shape
+        D = self.num_devices
+        blocks = hist.reshape(S, D, self.block, B, C)
+        blocks = jnp.moveaxis(blocks, 1, 0)           # [D, S, F/D, B, C]
+        return jax.lax.psum_scatter(blocks, self.axis, scatter_dimension=0,
+                                    tiled=False)
+
+    def block_meta(self, feature_ok, num_bins, missing_code, default_bin,
+                   is_cat) -> BlockMeta:
+        i = jax.lax.axis_index(self.axis)
+        b = self.block
+        return BlockMeta(
+            _block_slice(feature_ok, i, b), _block_slice(num_bins, i, b),
+            _block_slice(missing_code, i, b), _block_slice(default_bin, i, b),
+            _block_slice(is_cat, i, b), i * b)
+
+    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
+        return _gather_argmax(find_block_splits(hist, pg, ph, pc, bm, spec),
+                              self.axis)
+
+
+@dataclass(frozen=True)
+class FeatureParallelComm:
+    """Rows replicated; each device histograms one feature block."""
+    axis: str
+    num_devices: int
+    num_features: int                # padded: divisible by num_devices
+
+    @property
+    def block(self) -> int:
+        return self.num_features // self.num_devices
+
+    def reduce_scalars(self, *xs):
+        return xs                     # rows replicated -> sums already global
+
+    def hist_X(self, X):
+        i = jax.lax.axis_index(self.axis)
+        return jax.lax.dynamic_slice_in_dim(X, i * self.block, self.block, axis=1)
+
+    def reduce_hist(self, hist):
+        return hist                   # [S, F/D, B, 3] already global
+
+    block_meta = DataParallelComm.block_meta
+    find_splits = DataParallelComm.find_splits
+
+
+@dataclass(frozen=True)
+class VotingParallelComm:
+    """Rows sharded; PV-Tree two-phase split finding with top-k voting."""
+    axis: str
+    num_devices: int
+    num_features: int
+    top_k: int                        # config top_k (voting_parallel_tree_learner)
+
+    def reduce_scalars(self, *xs):
+        return tuple(jax.lax.psum(x, self.axis) for x in xs)
+
+    def hist_X(self, X):
+        return X
+
+    def reduce_hist(self, hist):
+        return hist                   # kept LOCAL; reduction happens per-vote
+
+    def block_meta(self, feature_ok, num_bins, missing_code, default_bin,
+                   is_cat) -> BlockMeta:
+        return BlockMeta(feature_ok, num_bins, missing_code, default_bin,
+                         is_cat, jnp.asarray(0, jnp.int32))
+
+    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
+        S, F, B, C = hist.shape
+        k = max(1, min(self.top_k, F))
+        k2 = min(2 * k, F)
+
+        # Phase 1 — local proposals. Parent sums are global here, matching the
+        # reference (local scans use global min_data constraints via
+        # smaller_leaf_splits_global_, voting_parallel_tree_learner.cpp:317).
+        pf_local, _ = block_per_feature(hist, pg, ph, pc, bm, spec)
+        local_gain = pf_local.gain
+        top_gain, top_feat = jax.lax.top_k(local_gain, k)           # [S, k]
+        votes = jnp.zeros((S, F), jnp.float32).at[
+            jnp.arange(S)[:, None], top_feat].add(
+                jnp.where(jnp.isfinite(top_gain), 1.0, 0.0))
+        votes = jax.lax.psum(votes, self.axis)                      # GlobalVoting :165
+
+        # Phase 2 — reduce only the winning features' histograms. Tie-break by
+        # summed local gain so a feature strong on one shard beats a tie.
+        finite_gain = jnp.where(jnp.isfinite(local_gain), local_gain, 0.0)
+        rank_score = votes + 1e-6 * jax.nn.sigmoid(
+            jax.lax.psum(finite_gain, self.axis))
+        _, sel = jax.lax.top_k(rank_score, k2)                      # [S, k2] global ids
+        sel_hist = jnp.take_along_axis(
+            hist, sel[:, :, None, None], axis=1)                    # [S, k2, B, 3]
+        sel_hist = jax.lax.psum(sel_hist, self.axis)
+
+        # Per-slot feature metadata: vmap the scan over slots since each slot
+        # selected different features.
+        def scan_slot(h_slot, sel_slot, pg_, ph_, pc_):
+            bm_slot = BlockMeta(
+                bm.feature_ok[sel_slot], bm.num_bins[sel_slot],
+                bm.missing_code[sel_slot], bm.default_bin[sel_slot],
+                bm.is_cat[sel_slot], jnp.asarray(0, jnp.int32))
+            cand = find_block_splits(h_slot[None], pg_[None], ph_[None],
+                                     pc_[None], bm_slot, spec)
+            return jax.tree.map(lambda a: a[0], cand)
+
+        cand = jax.vmap(scan_slot)(sel_hist, sel, pg, ph, pc)
+        # map local candidate index -> global feature id
+        feat = jnp.take_along_axis(sel, cand.feature[:, None], axis=1)[:, 0]
+        return cand._replace(feature=feat.astype(jnp.int32))
+
+
+class ParallelContext:
+    """Mesh + strategy + shardings for one Booster.
+
+    ``strategy`` follows the reference's `tree_learner` values
+    (config.h TreeLearnerType): serial | feature | data | voting.
+    """
+
+    ROW_AXIS = "shard"
+
+    def __init__(self, strategy: str, devices, top_k: int = 20):
+        self.strategy = strategy
+        self.devices = list(devices)
+        self.num_devices = len(self.devices)
+        self.top_k = top_k
+        if strategy == "serial" or self.num_devices == 1:
+            self.strategy = "serial"
+            self.mesh = None
+        else:
+            self.mesh = Mesh(np.array(self.devices), (self.ROW_AXIS,))
+
+    # -------------------------------------------------------------- shapes
+
+    def pad_features_to(self, F: int) -> int:
+        """Feature-block strategies need F divisible by the device count."""
+        if self.strategy in ("data", "feature") and self.num_devices > 1:
+            D = self.num_devices
+            return ((F + D - 1) // D) * D
+        return F
+
+    def pad_rows_multiple(self) -> int:
+        """Row padding granularity (rows sharded -> multiple of D)."""
+        return self.num_devices if self.strategy in ("data", "voting") else 1
+
+    def block_features(self, F_padded: int) -> int:
+        if self.strategy in ("data", "feature"):
+            return F_padded // self.num_devices
+        return F_padded
+
+    # ---------------------------------------------------------------- comm
+
+    def make_comm(self, num_features: int):
+        if self.strategy == "data":
+            return DataParallelComm(self.ROW_AXIS, self.num_devices, num_features)
+        if self.strategy == "feature":
+            return FeatureParallelComm(self.ROW_AXIS, self.num_devices, num_features)
+        if self.strategy == "voting":
+            return VotingParallelComm(self.ROW_AXIS, self.num_devices,
+                                      num_features, self.top_k)
+        return SerialComm(num_features)
+
+    # ---------------------------------------------------------- shard_map
+
+    def row_sharding(self):
+        """NamedSharding for [N, ...] arrays whose rows are distributed."""
+        if self.mesh is None or self.strategy == "feature":
+            return None
+        return NamedSharding(self.mesh, P(self.ROW_AXIS))
+
+    def shard_grow(self, grow_fn: Callable) -> Callable:
+        """Wrap ``grow_fn(X, grad, hess, included, feature_ok, num_bins,
+        missing_code, default_bin)`` in shard_map with this strategy's specs.
+        Tree outputs are replicated; leaf_id follows the row sharding."""
+        if self.mesh is None:
+            return grow_fn
+        rows = P(self.ROW_AXIS) if self.strategy in ("data", "voting") else P()
+        rows2d = P(self.ROW_AXIS, None) if self.strategy in ("data", "voting") else P()
+        in_specs = (rows2d, rows, rows, rows, P(), P(), P(), P(), P())
+        out_specs = (P(), rows)       # (TreeArrays..., leaf_id)
+        return jax.shard_map(grow_fn, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+
+
+def select_devices(config):
+    """Devices for this booster, honoring the reference's ``device`` param:
+    ``tpu`` (default) uses the accelerator backend; ``cpu`` forces the host
+    CPU backend — which under `--xla_force_host_platform_device_count=N`
+    exposes N virtual devices, the test bed for every parallel strategy."""
+    want = getattr(config, "device", "tpu")
+    if want == "cpu":
+        try:
+            return jax.devices("cpu")
+        except RuntimeError:
+            return jax.devices()
+    return jax.devices()
+
+
+def make_parallel_context(config, devices=None) -> ParallelContext:
+    """Build the context from config (reference: Network::Init,
+    application.cpp:167-178 — here the 'network' is just the device mesh)."""
+    strategy = getattr(config, "tree_learner", "serial")
+    if devices is None:
+        devices = select_devices(config)
+        nm = getattr(config, "num_machines", 1)
+        if nm and nm > 1:
+            devices = devices[: min(nm, len(devices))]
+        elif strategy == "serial":
+            devices = devices[:1]
+    return ParallelContext(strategy, devices, top_k=getattr(config, "top_k", 20))

@@ -141,11 +141,18 @@ def tree_from_device_arrays(arrs, mappers, real_feature_idx: np.ndarray) -> Tree
     M = max(nl - 1, 0)
     L = max(nl, 1)
     split_feature_inner = np.asarray(arrs.split_feature[:M], dtype=np.int32)
-    threshold_bin = np.asarray(arrs.threshold_bin[:M], dtype=np.int32)
+    threshold_bin = np.array(arrs.threshold_bin[:M], dtype=np.int32)
     default_left = np.asarray(arrs.default_left[:M], dtype=bool)
 
     threshold = np.zeros(M, dtype=np.float64)
     decision_type = np.zeros(M, dtype=np.uint8)
+    # categorical splits: convert the device bin-mask into a raw-category
+    # bitset pool (reference Tree::SplitCategorical converts bins to category
+    # values via BinMapper, tree.h:82-100; bitset layout tree.h:257-284)
+    dev_is_cat = np.asarray(getattr(arrs, "is_cat", np.zeros(0, bool)))
+    dev_cat_mask = np.asarray(getattr(arrs, "cat_mask", np.zeros((0, 0), bool)))
+    cat_boundaries: List[int] = [0]
+    cat_words: List[np.ndarray] = []
     for i in range(M):
         mapper = mappers[split_feature_inner[i]]
         dt = 0
@@ -157,7 +164,22 @@ def tree_from_device_arrays(arrs, mappers, real_feature_idx: np.ndarray) -> Tree
         decision_type[i] = dt
         if mapper.bin_type != BIN_CATEGORICAL:
             threshold[i] = float(mapper.bin_upper_bound[threshold_bin[i]])
+        else:
+            mask_bins = np.nonzero(dev_cat_mask[i])[0] if i < len(dev_is_cat) else []
+            cats = [int(mapper.bin_2_categorical[b]) for b in mask_bins
+                    if b < len(mapper.bin_2_categorical)
+                    and mapper.bin_2_categorical[b] >= 0]
+            n_words = (max(cats) // 32 + 1) if cats else 1
+            words = np.zeros(n_words, dtype=np.uint32)
+            for cval in cats:
+                words[cval // 32] |= np.uint32(1) << np.uint32(cval % 32)
+            cat_idx = len(cat_boundaries) - 1
+            threshold_bin[i] = cat_idx
+            threshold[i] = float(cat_idx)
+            cat_boundaries.append(cat_boundaries[-1] + n_words)
+            cat_words.append(words)
 
+    has_cat = len(cat_words) > 0
     return Tree(
         num_leaves=nl,
         split_feature=real_feature_idx[split_feature_inner].astype(np.int32),
@@ -172,4 +194,6 @@ def tree_from_device_arrays(arrs, mappers, real_feature_idx: np.ndarray) -> Tree
         leaf_value=np.asarray(arrs.leaf_value[:L], dtype=np.float64),
         leaf_count=np.asarray(arrs.leaf_count[:L], dtype=np.int64),
         leaf_parent=np.asarray(arrs.leaf_parent[:L], dtype=np.int32),
+        cat_boundaries=np.asarray(cat_boundaries, dtype=np.int32) if has_cat else None,
+        cat_threshold=np.concatenate(cat_words).astype(np.uint32) if has_cat else None,
     )

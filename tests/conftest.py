@@ -1,10 +1,25 @@
-"""Test harness config: force a CPU backend with 8 virtual devices so
-multi-chip sharding logic is exercised without TPU hardware (the capability
-the reference never had — its MPI path was only ever CI-tested single-process,
-SURVEY.md §4)."""
+"""Test harness config: force a hermetic CPU backend with 8 virtual devices
+so every parallel strategy (tree_learner=data|feature|voting) is exercised
+without TPU hardware — the capability the reference never had (its MPI path
+was only ever CI-tested single-process, SURVEY.md §4).
+
+The axon TPU plugin registers a backend factory at interpreter boot via
+sitecustomize and initializes on first backend access even when
+JAX_PLATFORMS=cpu — and a wedged tunnel then hangs every jax call. Tests must
+never depend on tunnel health, so the factory is dropped from the registry
+before any backend is instantiated.
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+from jax._src import xla_bridge  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+for _plat in list(xla_bridge._backend_factories):
+    if _plat != "cpu":
+        xla_bridge._backend_factories.pop(_plat, None)

@@ -1,0 +1,96 @@
+"""Categorical-feature tests (reference: tests/python_package_test/
+test_engine.py:213-280 categorical handling; feature_histogram.hpp:104-259).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _cat_data(n=3000, n_cats=12, seed=0):
+    rng = np.random.RandomState(seed)
+    cat = rng.randint(0, n_cats, n)
+    x1 = rng.randn(n)
+    y = np.where(np.isin(cat, [2, 5, 7]), 3.0, -1.0) + 0.5 * x1 + 0.1 * rng.randn(n)
+    return np.column_stack([cat.astype(float), x1]), y
+
+
+def test_categorical_sorted_mode_quality():
+    X, y = _cat_data()
+    params = dict(objective="regression", num_leaves=15, min_data_in_leaf=5,
+                  device="cpu", verbose=-1)
+    bst = lgb.train(params, lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=30)
+    mse = np.mean((bst.predict(X) - y) ** 2)
+    assert mse < np.var(y) * 0.05         # the categorical signal is found
+
+
+def test_categorical_beats_numerical_encoding():
+    # categories deliberately ordered so a numerical threshold can't isolate
+    # the positive set {2, 5, 7}; optimal categorical split can
+    X, y = _cat_data()
+    params = dict(objective="regression", num_leaves=4, min_data_in_leaf=5,
+                  device="cpu", verbose=-1)
+    bst_cat = lgb.train(params, lgb.Dataset(X, label=y, categorical_feature=[0]),
+                        num_boost_round=10)
+    bst_num = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    mse_cat = np.mean((bst_cat.predict(X) - y) ** 2)
+    mse_num = np.mean((bst_num.predict(X) - y) ** 2)
+    assert mse_cat < mse_num
+
+
+def test_categorical_onehot_mode():
+    rng = np.random.RandomState(1)
+    cat = rng.randint(0, 3, 2000)          # 3 bins <= max_cat_to_onehot=4
+    y = np.where(cat == 1, 2.0, 0.0) + 0.1 * rng.randn(2000)
+    X = cat.astype(float).reshape(-1, 1)
+    bst = lgb.train(dict(objective="regression", num_leaves=7, device="cpu",
+                         min_data_in_leaf=5, verbose=-1),
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=20)
+    assert np.mean((bst.predict(X) - y) ** 2) < 0.05
+
+
+def test_categorical_model_text_roundtrip():
+    X, y = _cat_data()
+    bst = lgb.train(dict(objective="regression", num_leaves=15, device="cpu",
+                         min_data_in_leaf=5, verbose=-1),
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=10)
+    s = bst.model_to_string()
+    assert "num_cat=" in s and "cat_threshold=" in s
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst2.predict(X), bst.predict(X), rtol=1e-12)
+
+
+def test_categorical_unseen_category_goes_right():
+    X, y = _cat_data()
+    bst = lgb.train(dict(objective="regression", num_leaves=15, device="cpu",
+                         min_data_in_leaf=5, verbose=-1),
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=10)
+    X_unseen = X.copy()[:10]
+    X_unseen[:, 0] = 99.0                  # category never seen in training
+    p = bst.predict(X_unseen)
+    assert np.isfinite(p).all()
+
+
+def test_categorical_parallel_strategies_agree():
+    X, y = _cat_data()
+    preds = {}
+    for tl in ("serial", "data", "feature"):
+        params = dict(objective="regression", num_leaves=15, min_data_in_leaf=5,
+                      device="cpu", tree_learner=tl, verbose=-1)
+        bst = lgb.train(params, lgb.Dataset(X, label=y, categorical_feature=[0]),
+                        num_boost_round=15)
+        preds[tl] = bst.predict(X)
+    np.testing.assert_array_equal(preds["serial"], preds["feature"])
+    np.testing.assert_allclose(preds["serial"], preds["data"], rtol=1e-4, atol=1e-4)
+
+
+def test_categorical_via_params_categorical_column():
+    X, y = _cat_data()
+    params = dict(objective="regression", num_leaves=15, min_data_in_leaf=5,
+                  device="cpu", categorical_column="0", verbose=-1)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=20)
+    assert np.mean((bst.predict(X) - y) ** 2) < np.var(y) * 0.1
