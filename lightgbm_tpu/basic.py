@@ -226,16 +226,34 @@ class Booster:
         self._gbdt.add_valid(name, data._binned_aligned, data._metadata)
         return self
 
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Reference LGBM_BoosterResetParameter (c_api.cpp) — used by the
+        reset_parameter callback for per-iteration schedules."""
+        self.params.update(params)
+        self.config = Config.from_params(self.params)
+        if self._gbdt is not None:
+            self._gbdt.reset_config(self.config)
+        return self
+
+    def rollback_one_iter(self) -> "Booster":
+        """Reference GBDT::RollbackOneIter via LGBM_BoosterRollbackOneIter."""
+        if self._gbdt is not None:
+            self._gbdt.rollback_one_iter()
+        return self
+
     def update(self, train_set=None, fobj=None) -> bool:
-        """One boosting iteration (reference LGBM_BoosterUpdateOneIter)."""
+        """One boosting iteration (reference LGBM_BoosterUpdateOneIter /
+        LGBM_BoosterUpdateOneIterCustom for user gradients)."""
         if fobj is not None:
-            Log.fatal("Custom objective in update() lands with the custom-fobj milestone")
-        self._gbdt.train_one_iter()
+            self._gbdt.train_one_iter_custom(fobj)
+        else:
+            self._gbdt.train_one_iter()
         return False
 
     def _finalize(self):
         forest = self._gbdt.finalize_model()
-        self.trees = [t for it_trees in forest for t in it_trees]
+        self.trees = getattr(self, "_prev_trees", []) + \
+            [t for it_trees in forest for t in it_trees]
         self.init_score_value = self._gbdt.init_score_value
         self.best_iteration = getattr(self._gbdt, "best_iteration", 0)
 
@@ -264,9 +282,54 @@ class Booster:
         if pred_leaf:
             out = np.stack([t.predict_leaf(X) for t in use_trees], axis=1)
             return out
-        raw = np.zeros((K, X.shape[0]), dtype=np.float64)
-        for i, t in enumerate(use_trees):
-            raw[i % K] += t.predict(X)
+        if pred_contrib:
+            # TreeSHAP contributions, [N, (F+1)*K] like the reference python
+            # package (basic.py predict pred_contrib; tree.h:340 PredictContrib)
+            F1 = self.num_total_features + 1
+            out = np.zeros((K, X.shape[0], F1))
+            for i, t in enumerate(use_trees):
+                out[i % K] += t.predict_contrib(X, self.num_total_features)
+            if self.config.boosting_normalized == "rf":
+                out /= max(len(use_trees) // K, 1)   # rf averages tree outputs
+            return out[0] if K == 1 else np.concatenate(
+                [out[k] for k in range(K)], axis=1)
+
+        N = X.shape[0]
+        raw = np.zeros((K, N), dtype=np.float64)
+        early_stop = bool(kwargs.get("pred_early_stop",
+                                     self.config.pred_early_stop))
+        if early_stop:
+            from .objectives import OBJECTIVE_ALIASES
+            obj = OBJECTIVE_ALIASES.get(self.config.objective, self.config.objective)
+            if obj not in ("binary", "multiclass", "multiclassova"):
+                # reference prediction_early_stop.cpp: binary/multiclass only
+                Log.fatal("Early stopping prediction is only supported for "
+                          "binary and multiclass objectives")
+        if early_stop and not raw_score and K >= 1 and len(use_trees):
+            # margin-based per-row early stop (prediction_early_stop.cpp:
+            # binary |raw| margin, multiclass top1-top2 margin)
+            freq = max(int(kwargs.get("pred_early_stop_freq",
+                                      self.config.pred_early_stop_freq)), 1)
+            margin_thr = float(kwargs.get("pred_early_stop_margin",
+                                          self.config.pred_early_stop_margin))
+            n_iter_used = len(use_trees) // K
+            active = np.ones(N, dtype=bool)
+            for it in range(n_iter_used):
+                rows = np.nonzero(active)[0]
+                if len(rows) == 0:
+                    break
+                for k in range(K):
+                    raw[k, rows] += use_trees[it * K + k].predict(X[rows])
+                if (it + 1) % freq == 0:
+                    if K == 1:
+                        margin = np.abs(raw[0, rows])
+                    else:
+                        part = np.sort(raw[:, rows], axis=0)
+                        margin = part[-1] - part[-2]
+                    active[rows] = margin < margin_thr
+        else:
+            for i, t in enumerate(use_trees):
+                raw[i % K] += t.predict(X)
         if self.config.boosting_normalized == "rf":
             # average of already-converted tree outputs (rf.hpp average_output_)
             raw /= max(len(use_trees) // K, 1)

@@ -287,7 +287,11 @@ class GBDT:
                 tree, leaf_ids = grow(
                     self.Xb, g[k] * mask, h[k] * mask, mask, fmask, self.is_cat,
                     self.num_bins, self.missing_code, self.default_bin)
-                tree = tree._replace(leaf_value=tree.leaf_value * shrinkage)
+                # reference Tree::Shrinkage scales internal_value_ too
+                # (tree.h:137-142) — TreeSHAP reads node means from it
+                tree = tree._replace(
+                    leaf_value=tree.leaf_value * shrinkage,
+                    internal_value=tree.internal_value * shrinkage)
                 tree = self._tree_output_transform(tree)
                 new_scores.append(self._score_update(score[k], tree.leaf_value[leaf_ids], it))
                 for vi, vs in enumerate(self.valid_sets):
@@ -351,6 +355,61 @@ class GBDT:
         self.score = score
         for vi, vs in enumerate(self.valid_sets):
             vs.score = jnp.stack(out_valid[vi])
+
+    def add_base_score(self, raw_scores: np.ndarray,
+                       valid_raw: Optional[List[np.ndarray]] = None) -> None:
+        """Seed scores with a loaded model's predictions — continued training
+        (reference: input_model re-predicted onto the data via PredictFunction,
+        application.cpp:90-93 / boosting.h:281-284)."""
+        K, Npad, N = self.num_models, self.num_data_padded, self.num_data
+        add = np.zeros((K, Npad), np.float32)
+        add[:, :N] = np.asarray(raw_scores, np.float32).reshape(K, N)
+        self.score = self.score + self._put(add, "rows1")
+        for vi, vs in enumerate(self.valid_sets):
+            if valid_raw is not None and vi < len(valid_raw):
+                vs.score = vs.score + self._put(
+                    np.asarray(valid_raw[vi], np.float32).reshape(K, vs.num_data))
+
+    def rollback_one_iter(self) -> None:
+        """Reference GBDT::RollbackOneIter (gbdt.cpp:475-491): pop the last
+        iteration's trees and subtract their contribution from all scores."""
+        if self.average_output:
+            Log.fatal("rollback_one_iter is not supported for rf boosting "
+                      "(scores are running averages, not additive)")
+        if not self.models:
+            return
+        trees = self.models.pop()
+        self._num_leaves_dev.pop()
+        self.iter_ -= 1
+        score = self.score
+        new_scores = []
+        for k, tree in enumerate(trees):
+            leaves = leaves_from_binned(tree, self.Xb, self.num_bins,
+                                        self.missing_code, self.default_bin)
+            new_scores.append(score[k] - tree.leaf_value[leaves])
+            for vs in self.valid_sets:
+                vleaves = leaves_from_binned(tree, vs.Xb, self.num_bins,
+                                             self.missing_code, self.default_bin)
+                vs.score = vs.score.at[k].add(-tree.leaf_value[vleaves])
+        self.score = jnp.stack(new_scores)
+
+    def reset_config(self, new_config: Config) -> None:
+        """Apply per-iteration tunable parameters (reference
+        LGBM_BoosterResetParameter). Structural parameters (num_leaves,
+        max_bin, ...) are compiled into the grower and cannot change here;
+        learning_rate & bagging settings take effect next iteration."""
+        old = self.config
+        self.config = new_config
+        self.bagging_on = (new_config.bagging_freq > 0
+                           and new_config.bagging_fraction < 1.0)
+        # bagging fraction/freq are baked into the compiled step as constants;
+        # drop the cached executable only when they changed (learning_rate is a
+        # traced argument — per-iteration schedules must not trigger re-trace)
+        if (old.bagging_freq != new_config.bagging_freq
+                or old.bagging_fraction != new_config.bagging_fraction
+                or old.feature_fraction != new_config.feature_fraction):
+            self._step_fn = None
+            self._custom_step_fn = None
 
     def _check_no_splits(self) -> bool:
         """Reference gbdt.cpp:465-471: pop the iteration and stop when no tree

@@ -77,10 +77,23 @@ def run_train(params: Dict) -> None:
     for i, vf in enumerate(config.valid_data):
         valid_sets.append(_load_dataset(vf, params, config, reference=train_set))
         valid_names.append(f"valid_{i + 1}" if len(config.valid_data) > 1 else "valid_1")
+    callbacks = []
+    if config.snapshot_freq > 0:
+        # reference: model.snapshot_iter_N every snapshot_freq iterations
+        # during training (gbdt.cpp:349-353, config.h:103)
+        def _snapshot(env):
+            it = env.iteration + 1
+            if it % config.snapshot_freq == 0:
+                env.model._finalize()
+                env.model.save_model(f"{config.output_model}.snapshot_iter_{it}")
+        _snapshot.order = 30
+        callbacks.append(_snapshot)
     booster = train_fn(params, train_set,
                        num_boost_round=config.num_iterations,
                        valid_sets=valid_sets, valid_names=valid_names,
-                       early_stopping_rounds=config.early_stopping_round or None)
+                       init_model=config.input_model or None,
+                       early_stopping_rounds=config.early_stopping_round or None,
+                       callbacks=callbacks)
     booster.save_model(config.output_model)
     Log.info("Finished training, model saved to %s", config.output_model)
 

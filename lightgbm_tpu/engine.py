@@ -37,6 +37,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if categorical_feature != "auto":
         train_set.categorical_feature = categorical_feature
 
+    prev_booster: Optional[Booster] = None
+    if init_model is not None:
+        prev_booster = init_model if isinstance(init_model, Booster) \
+            else Booster(params=params, model_file=init_model)
+
     booster = Booster(params=params, train_set=train_set)
     config = booster.config
     n_rounds = config.num_iterations
@@ -53,6 +58,30 @@ def train(params: Dict[str, Any], train_set: Dataset,
             vs.reference = train_set
         booster.add_valid(vs, name)
         names.append(name)
+
+    # continued training: seed scores with the loaded model's raw predictions
+    # (reference: input_model re-prediction, application.cpp:90-93) and keep
+    # its trees so the saved model contains the full forest
+    if prev_booster is not None and prev_booster.trees:
+        Kp = max(prev_booster.num_model_per_iteration, 1)
+        if Kp != booster._gbdt.num_models:
+            Log.fatal("init_model has %d models per iteration, training config "
+                      "has %d", Kp, booster._gbdt.num_models)
+        # keep exactly the trees whose predictions seed the scores: predict()
+        # honors the prev model's best_iteration, so truncate the kept forest
+        # the same way or the saved model would disagree with training
+        n_prev_iters = prev_booster.best_iteration \
+            if prev_booster.best_iteration > 0 else len(prev_booster.trees) // Kp
+        raw = np.asarray(prev_booster.predict(train_set.raw_data, raw_score=True))
+        raw = raw.T if raw.ndim == 2 else raw
+        valid_raw = []
+        for vs in valid_sets:
+            if vs is train_set:
+                continue
+            vraw = np.asarray(prev_booster.predict(vs.raw_data, raw_score=True))
+            valid_raw.append(vraw.T if vraw.ndim == 2 else vraw)
+        booster._gbdt.add_base_score(raw, valid_raw)
+        booster._prev_trees = list(prev_booster.trees[: n_prev_iters * Kp])
 
     callbacks = list(callbacks or [])
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
@@ -102,7 +131,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     booster._finalize()
     if best_iteration:
-        booster.best_iteration = best_iteration
+        # best_iteration indexes the FULL forest (prev + new): predict()
+        # slices self.trees from the front
+        n_prev = len(getattr(booster, "_prev_trees", [])) // \
+            max(booster._gbdt.num_models, 1)
+        booster.best_iteration = best_iteration + n_prev
     return booster
 
 

@@ -113,7 +113,108 @@ class Tree:
     def shrink(self, rate: float) -> None:
         """Tree::Shrinkage (tree.h:137-142)."""
         self.leaf_value = self.leaf_value * rate
+        self.internal_value = self.internal_value * rate
         self.shrinkage *= rate
+
+    # -- TreeSHAP feature contributions (reference tree.h:340-354
+    #    Tree::PredictContrib via TreeSHAP; Lundberg & Lee's algorithm) ------
+
+    def _child_count(self, child: int) -> float:
+        return float(self.leaf_count[~child] if child < 0
+                     else self.internal_count[child])
+
+    def expected_value(self) -> float:
+        """Count-weighted mean of leaf outputs (reference Tree::ExpectedValue,
+        src/io/tree.cpp:632)."""
+        if self.num_leaves <= 1:
+            return float(self.leaf_value[0]) if len(self.leaf_value) else 0.0
+        total = float(self.internal_count[0])
+        if total <= 0:
+            return 0.0
+        return float(np.dot(self.leaf_count[: self.num_leaves],
+                            self.leaf_value[: self.num_leaves]) / total)
+
+    def tree_shap_row(self, x: np.ndarray, phi: np.ndarray) -> None:
+        """Add this tree's per-feature contributions for one row into
+        ``phi`` [num_total_features + 1] (last slot = expected value)."""
+        phi[-1] += self.expected_value()
+        if self.num_leaves <= 1:
+            return
+
+        # path entries: (feature_index, zero_fraction, one_fraction, pweight)
+        def extend(path, zero_frac, one_frac, fi):
+            # rows must be copied: both recursion branches extend the same
+            # parent path and the weight updates below mutate rows in place
+            path = [row[:] for row in path] \
+                + [[fi, zero_frac, one_frac, 1.0 if not path else 0.0]]
+            l = len(path) - 1
+            for i in range(l - 1, -1, -1):
+                path[i + 1][3] += one_frac * path[i][3] * (i + 1) / (l + 1)
+                path[i][3] = zero_frac * path[i][3] * (l - i) / (l + 1)
+            return path
+
+        def unwind(path, i):
+            l = len(path) - 1
+            one_frac, zero_frac = path[i][2], path[i][1]
+            path = [row[:] for row in path]
+            n = path[l][3]
+            for j in range(l - 1, -1, -1):
+                if one_frac != 0.0:
+                    tmp = path[j][3]
+                    path[j][3] = n * (l + 1) / ((j + 1) * one_frac)
+                    n = tmp - path[j][3] * zero_frac * (l - j) / (l + 1)
+                else:
+                    path[j][3] = path[j][3] * (l + 1) / (zero_frac * (l - j))
+            for j in range(i, l):
+                path[j][0], path[j][1], path[j][2] = \
+                    path[j + 1][0], path[j + 1][1], path[j + 1][2]
+            return path[:-1]
+
+        def unwound_sum(path, i):
+            l = len(path) - 1
+            one_frac, zero_frac = path[i][2], path[i][1]
+            total = 0.0
+            n = path[l][3]
+            for j in range(l - 1, -1, -1):
+                if one_frac != 0.0:
+                    tmp = n * (l + 1) / ((j + 1) * one_frac)
+                    total += tmp
+                    n = path[j][3] - tmp * zero_frac * (l - j) / (l + 1)
+                else:
+                    total += path[j][3] / (zero_frac * (l - j) / (l + 1))
+            return total
+
+        def recurse(node, path, zero_frac, one_frac, parent_fi):
+            path = extend(path, zero_frac, one_frac, parent_fi)
+            if node < 0:                               # leaf
+                leaf_v = float(self.leaf_value[~node])
+                for i in range(1, len(path)):
+                    w = unwound_sum(path, i)
+                    phi[path[i][0]] += w * (path[i][2] - path[i][1]) * leaf_v
+                return
+            fi = int(self.split_feature[node])
+            hot = int(self._decide(node, x[fi:fi + 1].astype(np.float64))[0])
+            cold = (int(self.right_child[node]) if hot == self.left_child[node]
+                    else int(self.left_child[node]))
+            cnt = self._child_count(hot) + self._child_count(cold)
+            hot_frac = self._child_count(hot) / cnt if cnt > 0 else 0.0
+            cold_frac = self._child_count(cold) / cnt if cnt > 0 else 0.0
+            inc_zero, inc_one = 1.0, 1.0
+            for i in range(1, len(path)):
+                if path[i][0] == fi:
+                    inc_zero, inc_one = path[i][1], path[i][2]
+                    path = unwind(path, i)
+                    break
+            recurse(hot, path, inc_zero * hot_frac, inc_one, fi)
+            recurse(cold, path, inc_zero * cold_frac, 0.0, fi)
+
+        recurse(0, [], 1.0, 1.0, -1)
+
+    def predict_contrib(self, X: np.ndarray, num_total_features: int) -> np.ndarray:
+        out = np.zeros((X.shape[0], num_total_features + 1))
+        for r in range(X.shape[0]):
+            self.tree_shap_row(X[r], out[r])
+        return out
 
     def add_bias(self, bias: float) -> None:
         """Tree::AddBias — fold boost-from-average into the first tree."""

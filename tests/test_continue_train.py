@@ -1,0 +1,97 @@
+"""Continued training / rollback / parameter-reset tests
+(reference: test_engine.py:360-411 continued training from file/string/model;
+gbdt.cpp:475 RollbackOneIter; callback.py reset_parameter)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=1200, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.randn(n)
+    return X, y
+
+
+PARAMS = dict(objective="regression", num_leaves=15, min_data_in_leaf=5,
+              device="cpu", verbose=-1)
+
+
+def test_continue_from_booster():
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    bst1 = lgb.train(PARAMS, ds, num_boost_round=10)
+    mse1 = np.mean((bst1.predict(X) - y) ** 2)
+    bst2 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10,
+                     init_model=bst1)
+    mse2 = np.mean((bst2.predict(X) - y) ** 2)
+    assert bst2.num_trees() == 20
+    assert mse2 < mse1 * 0.9
+
+
+def test_continue_from_file(tmp_path):
+    X, y = _data()
+    bst1 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=8)
+    path = tmp_path / "m.txt"
+    bst1.save_model(str(path))
+    bst2 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=8,
+                     init_model=str(path))
+    assert bst2.num_trees() == 16
+    # continued model == base model + extra trees: prefix predictions agree
+    np.testing.assert_allclose(bst2.predict(X, num_iteration=8),
+                               bst1.predict(X), rtol=1e-5, atol=1e-6)
+
+
+def test_continue_equivalent_to_straight_run_quality():
+    X, y = _data()
+    bst_one = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=20)
+    bst_a = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    bst_b = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10,
+                      init_model=bst_a)
+    mse_one = np.mean((bst_one.predict(X) - y) ** 2)
+    mse_two = np.mean((bst_b.predict(X) - y) ** 2)
+    assert mse_two < mse_one * 1.5         # same ballpark quality
+
+
+def test_rollback_one_iter():
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params=PARAMS, train_set=ds)
+    for _ in range(5):
+        bst.update()
+    score5 = np.asarray(bst._gbdt.score).copy()
+    bst.update()
+    bst.rollback_one_iter()
+    score_rb = np.asarray(bst._gbdt.score)
+    np.testing.assert_allclose(score_rb, score5, rtol=1e-5, atol=1e-6)
+    bst._finalize()
+    assert bst.num_trees() == 5
+
+
+def test_reset_parameter_learning_rate_schedule():
+    X, y = _data()
+    lrs = [0.3] * 5 + [0.05] * 5
+    rec = []
+    bst = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y), num_boost_round=10,
+                    callbacks=[lgb.reset_parameter(learning_rate=lrs),
+                               lambda env: rec.append(
+                                   env.model.config.learning_rate)])
+    assert bst.num_trees() == 10
+    assert rec[0] == 0.3 and rec[-1] == 0.05
+
+
+def test_custom_fobj_via_update():
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params=dict(PARAMS, objective="none"), train_set=ds)
+
+    def fobj(preds, dataset):
+        grad = preds - y
+        hess = np.ones_like(preds)
+        return grad, hess
+
+    for _ in range(10):
+        bst.update(fobj=fobj)
+    bst._finalize()
+    pred = bst.predict(X)
+    assert np.mean((pred - y) ** 2) < np.var(y) * 0.5

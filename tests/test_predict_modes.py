@@ -1,0 +1,84 @@
+"""Prediction-mode tests: SHAP contribs, leaf index, early stop.
+
+Reference analogs: test_engine.py:532 (contribs sum == prediction),
+test_engine.py:302 (prediction early stopping), prediction_early_stop.cpp.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=800, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] * 2 + X[:, 1] ** 2 + 0.1 * rng.randn(n)
+    return X, y
+
+
+def test_contrib_sums_to_raw_prediction():
+    X, y = _data()
+    bst = lgb.train(dict(objective="regression", num_leaves=15, device="cpu",
+                         min_data_in_leaf=5, verbose=-1),
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    contrib = bst.predict(X[:50], pred_contrib=True)
+    assert contrib.shape == (50, X.shape[1] + 1)
+    raw = bst.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6, atol=1e-6)
+
+
+def test_contrib_identifies_important_feature():
+    X, y = _data()
+    bst = lgb.train(dict(objective="regression", num_leaves=15, device="cpu",
+                         min_data_in_leaf=5, verbose=-1),
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    contrib = bst.predict(X[:200], pred_contrib=True)
+    mean_abs = np.abs(contrib[:, :-1]).mean(axis=0)
+    assert mean_abs[0] == mean_abs.max()      # x0 dominates y
+
+
+def test_contrib_multiclass_shape():
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 5)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    bst = lgb.train(dict(objective="multiclass", num_class=3, device="cpu",
+                         num_leaves=7, verbose=-1),
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    contrib = bst.predict(X[:20], pred_contrib=True)
+    assert contrib.shape == (20, 3 * (5 + 1))
+
+
+def test_contrib_sums_binary():
+    rng = np.random.RandomState(2)
+    X = rng.randn(600, 4)
+    y = ((X[:, 0] + X[:, 1] * 0.5) > 0).astype(float)
+    bst = lgb.train(dict(objective="binary", num_leaves=7, device="cpu",
+                         verbose=-1), lgb.Dataset(X, label=y),
+                    num_boost_round=8)
+    contrib = bst.predict(X[:30], pred_contrib=True)
+    raw = bst.predict(X[:30], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6, atol=1e-6)
+
+
+def test_pred_early_stop_binary_close():
+    rng = np.random.RandomState(3)
+    X = rng.randn(500, 5)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(dict(objective="binary", num_leaves=15, device="cpu",
+                         verbose=-1), lgb.Dataset(X, label=y),
+                    num_boost_round=40)
+    full = bst.predict(X)
+    es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                     pred_early_stop_margin=8.0)
+    # classification decisions must agree; probabilities may differ slightly
+    assert np.mean((full > 0.5) == (es > 0.5)) > 0.99
+
+
+def test_pred_leaf_shape_and_range():
+    X, y = _data()
+    bst = lgb.train(dict(objective="regression", num_leaves=15, device="cpu",
+                         min_data_in_leaf=5, verbose=-1),
+                    lgb.Dataset(X, label=y), num_boost_round=7)
+    leaves = bst.predict(X[:40], pred_leaf=True)
+    assert leaves.shape == (40, 7)
+    assert leaves.min() >= 0 and leaves.max() < 15
