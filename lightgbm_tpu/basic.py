@@ -243,12 +243,58 @@ class Booster:
 
     def update(self, train_set=None, fobj=None) -> bool:
         """One boosting iteration (reference LGBM_BoosterUpdateOneIter /
-        LGBM_BoosterUpdateOneIterCustom for user gradients)."""
+        LGBM_BoosterUpdateOneIterCustom for user gradients).
+
+        ``train_set`` swaps the training data under the existing model
+        (reference LGBM_BoosterResetTrainingData, c_api.cpp): the new data's
+        scores are seeded with the current forest's raw predictions.
+        """
+        if train_set is not None and train_set is not getattr(
+                self, "train_dataset", None):
+            if self._gbdt is not None:
+                self._finalize()
+            prev = list(self.trees)
+            # capture before construct(): free_raw_data nulls raw_data
+            X_new = train_set.raw_data
+            if prev and X_new is None:
+                Log.fatal("update(train_set=...) on a trained booster needs "
+                          "the new Dataset's raw data to seed scores — "
+                          "construct it with free_raw_data=False")
+            self._setup_train(train_set)
+            if prev:
+                gbdt = self._gbdt
+                # seed from model predictions ONLY: drop the fresh
+                # boost-from-average bias (reference BoostFromAverage applies
+                # only to an empty model, gbdt.cpp:357-377)
+                if abs(gbdt.init_score_value) > 1e-15:
+                    gbdt.score = gbdt.score - gbdt.init_score_value
+                    for _vs in gbdt.valid_sets:
+                        _vs.score = _vs.score - gbdt.init_score_value
+                    gbdt.init_score_value = 0.0
+                K = max(self.num_model_per_iteration, 1)
+                raw = np.asarray(self.predict(X_new, raw_score=True,
+                                              num_iteration=len(prev) // K))
+                raw = raw.T if raw.ndim == 2 else raw
+                gbdt.add_base_score(raw)
+                self._prev_trees = prev
+        if self._gbdt is None:
+            Log.fatal("Booster has no training data: it was freed (train() "
+                      "without keep_training_booster=True) — pass train_set "
+                      "to update() to attach data")
         if fobj is not None:
             self._gbdt.train_one_iter_custom(fobj)
         else:
             self._gbdt.train_one_iter()
         return False
+
+    def free_dataset(self) -> "Booster":
+        """Release device-side training state (reference basic.py
+        free_dataset): the booster stays usable for predict/save/load but
+        cannot continue training without a new train_set."""
+        self._gbdt = None
+        if hasattr(self, "train_dataset"):
+            del self.train_dataset
+        return self
 
     def _finalize(self):
         forest = self._gbdt.finalize_model()
@@ -322,7 +368,9 @@ class Booster:
                     raw[k, rows] += use_trees[it * K + k].predict(X[rows])
                 if (it + 1) % freq == 0:
                     if K == 1:
-                        margin = np.abs(raw[0, rows])
+                        # reference CreateBinary margin = 2*|raw|
+                        # (prediction_early_stop.cpp)
+                        margin = 2.0 * np.abs(raw[0, rows])
                     else:
                         part = np.sort(raw[:, rows], axis=0)
                         margin = part[-1] - part[-2]

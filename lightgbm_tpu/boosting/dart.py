@@ -30,11 +30,16 @@ class DART(GBDT):
         self.tree_weight: List[float] = []
         self.sum_weight = 0.0
         self._drop_rng = np.random.default_rng(config.drop_seed)
-        self._contrib_fn = jax.jit(self._tree_contrib)
+        # train matrix may be EFB-bundled; valid matrices never are
+        self._contrib_fn = jax.jit(
+            lambda tree, Xb: self._tree_contrib(tree, Xb, self.bundle))
+        self._contrib_fn_valid = jax.jit(
+            lambda tree, Xb: self._tree_contrib(tree, Xb, None))
 
-    def _tree_contrib(self, tree, Xb):
+    def _tree_contrib(self, tree, Xb, bundle):
         leaves = leaves_from_binned(tree, Xb, self.num_bins,
-                                    self.missing_code, self.default_bin)
+                                    self.missing_code, self.default_bin,
+                                    bundle=bundle)
         return tree.leaf_value[leaves]
 
     def _select_drop(self) -> List[int]:
@@ -82,7 +87,7 @@ class DART(GBDT):
                     drop_train = drop_train.at[c].add(self._contrib_fn(tree, self.Xb))
                     for vi, vs in enumerate(self.valid_sets):
                         drop_valid[vi] = drop_valid[vi].at[c].add(
-                            self._contrib_fn(tree, vs.Xb))
+                            self._contrib_fn_valid(tree, vs.Xb))
             score_adj = self.score - drop_train
             for vi, vs in enumerate(self.valid_sets):
                 vs.score = vs.score - drop_valid[vi]

@@ -99,14 +99,55 @@ class GBDT:
         self.num_data = N
         self.num_data_padded = Npad
 
-        Xb = train_set.X_binned
-        self.Xb = self._put(np.pad(Xb, ((0, Npad - N), (0, F_pad - F))), "rows0")
+        meta = train_set.feature_meta_arrays()
+        num_leaves = config.max_leaves_by_depth
+        Bpad = max(8, _round_up(train_set.max_num_bin, 8))
+
+        # ---- EFB bundling (reference Dataset::Construct enable_bundle path,
+        #      dataset.cpp:236-247): pack near-exclusive features into fewer
+        #      histogram columns. Serial strategy only — distributed feature
+        #      blocking would need equal-width bundled blocks per device. ----
+        self.bundle = None
+        bundle_plan = None
+        if (config.enable_bundle and self.pctx.strategy == "serial" and F >= 2):
+            from ..efb import plan_bundles
+            plan = plan_bundles(train_set.X_binned,
+                                meta["num_bins"].astype(np.int64),
+                                meta["default_bin"].astype(np.int64), config)
+            if plan is not None:
+                Bb_pad = max(8, _round_up(plan.max_bundle_bins, 8))
+                # bundle only when it shrinks the one-hot matmul (G*Bb < F*B)
+                if plan.num_groups * Bb_pad < 0.9 * F * Bpad:
+                    bundle_plan = plan
+                    Log.info("EFB: %d features bundled into %d columns "
+                             "(%d max bundle bins)", F, plan.num_groups,
+                             plan.max_bundle_bins)
+
+        if bundle_plan is not None:
+            Bb_pad = max(8, _round_up(bundle_plan.max_bundle_bins, 8))
+            Xb = bundle_plan.X_bundled
+            self.Xb = self._put(np.pad(Xb, ((0, Npad - N), (0, 0))), "rows0")
+            fpad = F_pad - F
+            ub = np.pad(bundle_plan.unpack_bin,
+                        ((0, fpad), (0, Bpad - bundle_plan.unpack_bin.shape[1])),
+                        constant_values=-1)
+            from ..grower import BundleDecode
+            self.bundle = BundleDecode(
+                col=self._put(np.pad(bundle_plan.col, (0, fpad))),
+                lo=self._put(np.pad(bundle_plan.lo, (0, fpad))),
+                hi=self._put(np.pad(bundle_plan.hi, (0, fpad))),
+                off=self._put(np.pad(bundle_plan.off, (0, fpad))),
+                unpack_bin=self._put(ub))
+            self._hist_bins = Bb_pad
+        else:
+            Xb = train_set.X_binned
+            self.Xb = self._put(np.pad(Xb, ((0, Npad - N), (0, F_pad - F))), "rows0")
+            self._hist_bins = 0
         self.label = self._put(np.pad(train_set.metadata.label, (0, Npad - N)), "rows")
         w = train_set.metadata.weight
         self.weight = None if w is None else self._put(np.pad(w, (0, Npad - N)), "rows")
         self.pad_mask = self._put((np.arange(Npad) < N).astype(np.float32), "rows")
 
-        meta = train_set.feature_meta_arrays()
         fpad = F_pad - F
         self.num_bins = self._put(np.pad(meta["num_bins"], (0, fpad), constant_values=1))
         self.missing_code = self._put(np.pad(meta["missing_code"], (0, fpad)))
@@ -117,8 +158,6 @@ class GBDT:
         ok = np.arange(F_pad) < F                           # padding features off
         self.feature_ok_base = self._put(ok)
 
-        num_leaves = config.max_leaves_by_depth
-        Bpad = max(8, _round_up(train_set.max_num_bin, 8))
         slots = config.tpu_hist_slots or max(1, min(16, num_leaves - 1))
         wave = config.tpu_wave_size or slots
         self.spec = GrowerSpec(
@@ -135,6 +174,8 @@ class GBDT:
             min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
             min_gain_to_split=config.min_gain_to_split,
             num_block_features=self.pctx.block_features(F_pad),
+            row_compact=config.tpu_row_compact,
+            hist_bins=self._hist_bins,
             use_categorical=bool(meta["is_categorical"].any()),
             cat_smooth=config.cat_smooth,
             cat_l2=config.cat_l2,
@@ -257,8 +298,11 @@ class GBDT:
         K = self.num_models
         comm = self.comm
 
+        bundle = self.bundle
+
         def grow_fn(X, g, h, inc, fok, iscat, nb, mc, db):
-            return grow_tree(X, g, h, inc, fok, iscat, nb, mc, db, spec, comm)
+            return grow_tree(X, g, h, inc, fok, iscat, nb, mc, db, spec, comm,
+                             bundle=bundle)
 
         grow = self.pctx.shard_grow(grow_fn)
 
@@ -385,7 +429,8 @@ class GBDT:
         new_scores = []
         for k, tree in enumerate(trees):
             leaves = leaves_from_binned(tree, self.Xb, self.num_bins,
-                                        self.missing_code, self.default_bin)
+                                        self.missing_code, self.default_bin,
+                                        bundle=self.bundle)
             new_scores.append(score[k] - tree.leaf_value[leaves])
             for vs in self.valid_sets:
                 vleaves = leaves_from_binned(tree, vs.Xb, self.num_bins,
@@ -402,12 +447,40 @@ class GBDT:
         self.config = new_config
         self.bagging_on = (new_config.bagging_freq > 0
                            and new_config.bagging_fraction < 1.0)
-        # bagging fraction/freq are baked into the compiled step as constants;
-        # drop the cached executable only when they changed (learning_rate is a
-        # traced argument — per-iteration schedules must not trigger re-trace)
+        # Hyperparameters baked into GrowerSpec as trace-time constants take
+        # effect by rebuilding the spec and dropping the cached executable.
+        spec_changes = {}
+        for field, attr in (
+                ("lambda_l1", "lambda_l1"), ("lambda_l2", "lambda_l2"),
+                ("min_gain_to_split", "min_gain_to_split"),
+                ("cat_smooth", "cat_smooth"), ("cat_l2", "cat_l2"),
+                ("max_cat_threshold", "max_cat_threshold"),
+                ("max_cat_to_onehot", "max_cat_to_onehot")):
+            if getattr(old, attr) != getattr(new_config, attr):
+                spec_changes[field] = getattr(new_config, attr)
+        if old.min_data_in_leaf != new_config.min_data_in_leaf:
+            spec_changes["min_data_in_leaf"] = float(new_config.min_data_in_leaf)
+        if old.min_sum_hessian_in_leaf != new_config.min_sum_hessian_in_leaf:
+            spec_changes["min_sum_hessian_in_leaf"] = new_config.min_sum_hessian_in_leaf
+        if old.min_data_per_group != new_config.min_data_per_group:
+            spec_changes["min_data_per_group"] = float(new_config.min_data_per_group)
+        retrace = bool(spec_changes)
+        if spec_changes:
+            import dataclasses
+            self.spec = dataclasses.replace(self.spec, **spec_changes)
+        # bagging fraction/freq are also compiled-in constants (learning_rate
+        # is a traced argument — per-iteration schedules must not re-trace)
         if (old.bagging_freq != new_config.bagging_freq
                 or old.bagging_fraction != new_config.bagging_fraction
                 or old.feature_fraction != new_config.feature_fraction):
+            retrace = True
+        if old.feature_fraction != new_config.feature_fraction:
+            F = self.train_set.num_features
+            self.n_feature_sample = max(
+                1, int(round(new_config.feature_fraction * F)))
+            self.use_feature_fraction = (new_config.feature_fraction < 1.0
+                                         and self.n_feature_sample < F)
+        if retrace:
             self._step_fn = None
             self._custom_step_fn = None
 

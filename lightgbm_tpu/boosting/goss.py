@@ -33,8 +33,11 @@ class GOSS(GBDT):
         warmup = int(1.0 / cfg.learning_rate)
 
         weights = jnp.sum(jnp.abs(g * h), axis=0) * self.pad_mask  # [Npad]
-        thr = jax.lax.top_k(weights, top_k)[0][-1]
-        is_top = (weights >= thr) & (self.pad_mask > 0)
+        # exactly top_k rows even on tied |g*h| (ties broken by row index,
+        # like the reference's sort-then-cut, goss.hpp:94-98)
+        _, top_idx = jax.lax.top_k(weights, top_k)
+        is_top = (jnp.zeros(weights.shape, bool).at[top_idx].set(True)
+                  & (self.pad_mask > 0))
         rest = (~is_top) & (self.pad_mask > 0)
         prob = other_k / max(N - top_k, 1)
         sel_other = rest & (jax.random.uniform(key, weights.shape) < prob)

@@ -282,6 +282,10 @@ class Config:
     tpu_hist_hilo: bool = True
     # number of leaf slots whose histograms are built in one pass
     tpu_hist_slots: int = 0                   # 0 = auto
+    # row compaction: each wave histograms only rows in pending leaves via a
+    # prefix-compacted index gather (the analog of the reference's
+    # smaller-leaf histogramming, serial_tree_learner.cpp:354-362)
+    tpu_row_compact: bool = True
 
     def __post_init__(self):
         self._check()

@@ -72,6 +72,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # the same way or the saved model would disagree with training
         n_prev_iters = prev_booster.best_iteration \
             if prev_booster.best_iteration > 0 else len(prev_booster.trees) // Kp
+        # continued training seeds from model predictions ONLY: drop the fresh
+        # booster's boost-from-average bias (reference BoostFromAverage applies
+        # only to an empty model, gbdt.cpp:357-377)
+        if abs(booster._gbdt.init_score_value) > 1e-15:
+            iv = booster._gbdt.init_score_value
+            booster._gbdt.score = booster._gbdt.score - iv
+            for _vs in booster._gbdt.valid_sets:
+                _vs.score = _vs.score - iv
+            booster._gbdt.init_score_value = 0.0
         raw = np.asarray(prev_booster.predict(train_set.raw_data, raw_score=True))
         raw = raw.T if raw.ndim == 2 else raw
         valid_raw = []
@@ -136,6 +145,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
         n_prev = len(getattr(booster, "_prev_trees", [])) // \
             max(booster._gbdt.num_models, 1)
         booster.best_iteration = best_iteration + n_prev
+    if not keep_training_booster:
+        # reference engine.py:222-224: the returned booster releases its
+        # training buffers (host trees are already detached from device state,
+        # so no model-string round-trip is needed)
+        booster.free_dataset()
     return booster
 
 

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .ops.histogram import build_histograms, root_sums
+from .ops.histogram import build_histograms, compact_rows, root_sums
 from .ops.split_finder import SplitCandidates, leaf_output
 
 NEG_INF = -jnp.inf
@@ -66,6 +66,37 @@ class TreeArrays(NamedTuple):
     leaf_count: jnp.ndarray       # f32 [L+1]
     leaf_parent: jnp.ndarray      # i32 [L+1]
     num_leaves: jnp.ndarray       # i32 scalar: leaves actually grown
+
+
+class BundleDecode(NamedTuple):
+    """Device-side EFB decode tables (efb.py BundlePlan, per scan feature).
+
+    ``X`` passed to the grower holds BUNDLED columns; these map original
+    feature f to its bundled column and code range:
+    ``orig_bin = code - off[f] if lo[f] <= code < hi[f] else default_bin[f]``.
+    ``unpack_bin[f, b]`` is the bundle-bin holding original bin b (-1 for the
+    default bin — reconstructed by subtraction, the reference's FixHistogram,
+    dataset.cpp:750-769).
+    """
+    col: jnp.ndarray          # i32 [F]
+    lo: jnp.ndarray           # i32 [F]
+    hi: jnp.ndarray           # i32 [F]
+    off: jnp.ndarray          # i32 [F]
+    unpack_bin: jnp.ndarray   # i32 [F, B]
+
+
+def decode_bundled_bin(Xb: jnp.ndarray, f: jnp.ndarray,
+                       bundle: "BundleDecode",
+                       default_bin: jnp.ndarray) -> jnp.ndarray:
+    """Per-row original bin of feature ``f[i]`` from the bundled matrix.
+
+    The single source of truth for EFB decode — training-time row routing and
+    prediction-time traversal both use it, so they cannot drift apart.
+    """
+    c = jnp.take_along_axis(Xb, bundle.col[f][:, None],
+                            axis=1)[:, 0].astype(jnp.int32)
+    in_rng = (c >= bundle.lo[f]) & (c < bundle.hi[f])
+    return jnp.where(in_rng, c - bundle.off[f], default_bin[f])
 
 
 class GrowState(NamedTuple):
@@ -102,6 +133,9 @@ class GrowerSpec:
     min_gain_to_split: float
     num_block_features: int = 0   # features this device SCANS (0 = num_features);
                                   # < num_features under data-parallel psum_scatter
+    row_compact: bool = True      # histogram only pending-leaf rows per wave
+    hist_bins: int = 0            # bin axis of the histogram BUILD (EFB bundle
+                                  # space); 0 = num_bins_padded (unbundled)
     # categorical split search (reference config.h:230-234)
     use_categorical: bool = False
     cat_smooth: float = 10.0
@@ -147,6 +181,24 @@ def _empty_tree(L: int, B: int) -> TreeArrays:
     )
 
 
+def _unpack_bundled(hist_g: jnp.ndarray, bundle: BundleDecode,
+                    pg: jnp.ndarray, ph: jnp.ndarray, pc: jnp.ndarray,
+                    default_bin: jnp.ndarray) -> jnp.ndarray:
+    """EFB unpack: [T, G, Bb, 3] bundle-space histograms -> [T, F, B, 3]
+    original-feature space, reconstructing each feature's default bin by
+    subtraction from the leaf totals (reference Dataset::FixHistogram,
+    dataset.cpp:750-769 — applied per scanned feature there too)."""
+    ub = bundle.unpack_bin                           # [F, B]
+    h = hist_g[:, bundle.col]                        # [T, F, Bb, 3]
+    idx = jnp.maximum(ub, 0)[None, :, :, None]
+    hf = jnp.take_along_axis(h, idx, axis=2)         # [T, F, B, 3]
+    hf = jnp.where((ub >= 0)[None, :, :, None], hf, 0.0)
+    totals = jnp.stack([pg, ph, pc], axis=-1)        # [T, 3]
+    deficit = totals[:, None, :] - hf.sum(axis=2)    # [T, F, 3]
+    F = ub.shape[0]
+    return hf.at[:, jnp.arange(F), default_bin, :].add(deficit)
+
+
 def _empty_cand(L: int, B: int) -> SplitCandidates:
     return SplitCandidates(
         gain=jnp.full(L + 1, NEG_INF, jnp.float32),
@@ -162,7 +214,7 @@ def _empty_cand(L: int, B: int) -> SplitCandidates:
 
 
 def grow_tree(
-    X: jnp.ndarray,               # [N, F] bin codes, rows padded with leaf_id=L sentinel
+    X: jnp.ndarray,               # [N, F] bin codes ([N, G] bundled under EFB)
     grad: jnp.ndarray,            # [N] f32, bagging/padding-masked
     hess: jnp.ndarray,            # [N] f32
     included: jnp.ndarray,        # [N] f32 0/1
@@ -173,6 +225,7 @@ def grow_tree(
     default_bin: jnp.ndarray,     # [F] i32
     spec: GrowerSpec,
     comm=None,
+    bundle: Optional[BundleDecode] = None,
 ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree; returns (tree arrays, final leaf_id per row).
 
@@ -180,6 +233,12 @@ def grow_tree(
     shard_map: X/grad/hess/leaf_id may be row-local shards, the histogram
     cache covers only this device's feature block, and split candidates are
     globally synced — the tree arrays stay replicated on every device.
+
+    With ``bundle`` (EFB, efb.py), ``X`` holds bundled columns: histograms
+    build + cache in bundle space ([.., G, hist_bins, ..]), get unpacked to
+    original feature space before the split scan, and row routing decodes
+    the original bin from the bundled code. Tree arrays are ALWAYS in
+    original feature space.
     """
     if comm is None:
         from .parallel.comm import SerialComm
@@ -191,6 +250,8 @@ def grow_tree(
     B = spec.num_bins_padded
     N = X.shape[0]
     X_hist = comm.hist_X(X)       # columns this device histograms
+    F_hist = X_hist.shape[1]      # == F unless bundled (then G)
+    B_hist = spec.hist_bins or B  # bundle-space bin axis
     bm = comm.block_meta(feature_ok, num_bins, missing_code, default_bin, is_cat)
 
     rg, rh, rc = comm.reduce_scalars(*root_sums(grad, hess, included))
@@ -199,7 +260,7 @@ def grow_tree(
     state = GrowState(
         tree=tree,
         leaf_id=jnp.zeros(N, jnp.int32),
-        hist=jnp.zeros((L + 1, F, B, 3), jnp.float32),
+        hist=jnp.zeros((L + 1, F_hist, B_hist, 3), jnp.float32),
         sum_g=jnp.zeros(L + 1, jnp.float32).at[0].set(rg),
         sum_h=jnp.zeros(L + 1, jnp.float32).at[0].set(rh),
         cnt=jnp.zeros(L + 1, jnp.float32).at[0].set(rc),
@@ -229,9 +290,14 @@ def grow_tree(
         # then the distributed reduction: psum_scatter for data-parallel
         # (reference data_parallel_tree_learner.cpp:148-163), identity
         # otherwise; output covers this device's feature block only.
+        if spec.row_compact:
+            row_idx, n_active = compact_rows(state.leaf_id, slot_of_leaf)
+        else:
+            row_idx = n_active = None
         new_hist = build_histograms(
             X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
-            num_slots=S, num_bins_padded=B, chunk_rows=spec.chunk_rows)
+            num_slots=S, num_bins_padded=B_hist, chunk_rows=spec.chunk_rows,
+            row_idx=row_idx, n_active=n_active)
         new_hist = comm.reduce_hist(new_hist)
 
         # ---- 3. cache write + sibling by subtraction -----------------------
@@ -247,6 +313,10 @@ def grow_tree(
         # ---- 4. split scan for the 2S touched leaves -----------------------
         scan_leaves = jnp.concatenate([leaf_of_slot, jnp.where(slot_valid, sibs, L)])
         scan_hist = jnp.concatenate([new_hist, sib_hist], axis=0)  # [2S, F, B, 3]
+        if bundle is not None:
+            scan_hist = _unpack_bundled(
+                scan_hist, bundle, state.sum_g[scan_leaves],
+                state.sum_h[scan_leaves], state.cnt[scan_leaves], default_bin)
         # candidate features are GLOBAL indices; under feature/data
         # parallelism this ends in an all-gather argmax across devices
         # (reference SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207)
@@ -340,7 +410,10 @@ def grow_tree(
         lid = state.leaf_id
         f_row = map_feat[lid]                                     # [N]
         f_safe = jnp.maximum(f_row, 0)
-        x_bin = jnp.take_along_axis(X, f_safe[:, None], axis=1)[:, 0].astype(jnp.int32)
+        if bundle is None:
+            x_bin = jnp.take_along_axis(X, f_safe[:, None], axis=1)[:, 0].astype(jnp.int32)
+        else:
+            x_bin = decode_bundled_bin(X, f_safe, bundle, default_bin)
         mcode = missing_code[f_safe]
         nbin = num_bins[f_safe]
         dbin = default_bin[f_safe]

@@ -39,34 +39,77 @@ def _split_hi_lo(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return hi, lo
 
 
+def compact_rows(leaf_id: jnp.ndarray, slot_of_leaf: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Prefix-compact the indices of rows whose leaf is pending a histogram.
+
+    Returns (row_idx [N] i32, n_active i32): the first `n_active` entries of
+    `row_idx` are the indices of rows in pending leaves (original order); the
+    rest are garbage and masked out downstream. The TPU analog of the
+    reference's leaf-contiguous DataPartition (data_partition.hpp:94):
+    instead of maintaining a permutation across splits, we rebuild the
+    pending-rows prefix each wave with one cumsum + one monotonic scatter —
+    both cheap VPU streams next to the histogram matmul they gate.
+    """
+    n = leaf_id.shape[0]
+    pending = slot_of_leaf[leaf_id] >= 0                          # [N] bool
+    pos = jnp.cumsum(pending.astype(jnp.int32)) - 1               # [N]
+    n_active = jnp.where(n > 0, pos[-1] + 1, 0)
+    row_idx = jnp.zeros(n, jnp.int32).at[
+        jnp.where(pending, pos, n)                                # invalid -> dropped
+    ].set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    return row_idx, n_active
+
+
 def build_histograms(
     X: jnp.ndarray,          # [N, F] uint8/uint16 bin codes (N padded to chunk multiple)
     grad: jnp.ndarray,       # [N] f32 (bagging-masked)
     hess: jnp.ndarray,       # [N] f32 (bagging-masked)
     included: jnp.ndarray,   # [N] f32 0/1 bagging/padding mask (count channel)
-    leaf_id: jnp.ndarray,    # [N] i32 current leaf of each row (padding rows -> num_leaves)
+    leaf_id: jnp.ndarray,    # [N] i32 current leaf of each row (padding rows masked)
     slot_of_leaf: jnp.ndarray,  # [L+1] i32 leaf -> histogram slot, -1 = not pending
     num_slots: int,
     num_bins_padded: int,
     chunk_rows: int,
+    row_idx: jnp.ndarray = None,   # [N] i32 from compact_rows (optional)
+    n_active: jnp.ndarray = None,  # i32 count of valid row_idx entries
 ) -> jnp.ndarray:
-    """Returns hist [num_slots, F, num_bins_padded, 3] f32 (sum_g, sum_h, count)."""
+    """Returns hist [num_slots, F, num_bins_padded, 3] f32 (sum_g, sum_h, count).
+
+    With (row_idx, n_active) the pass is *row-compacted*: only
+    ceil(n_active/chunk_rows) chunks run (a dynamic-trip-count while_loop),
+    each gathering its rows through row_idx — the analog of the reference
+    histogramming only the smaller leaf's rows
+    (serial_tree_learner.cpp:354-362) instead of a full-data pass per wave.
+    """
     n_rows, num_features = X.shape
     assert n_rows % chunk_rows == 0, (n_rows, chunk_rows)
     n_chunks = n_rows // chunk_rows
     ch = NUM_CHANNELS
+    compact = row_idx is not None
     iota_bins = jnp.arange(num_bins_padded, dtype=jnp.int32)[None, None, :]
     iota_slots = jnp.arange(num_slots, dtype=jnp.int32)[None, :]
+    iota_chunk = jnp.arange(chunk_rows, dtype=jnp.int32)
 
-    def chunk_body(acc, i):
+    def chunk_part(i, acc):
         sl = jax.lax.dynamic_slice_in_dim
-        xc = sl(X, i * chunk_rows, chunk_rows)
-        gc = sl(grad, i * chunk_rows, chunk_rows)
-        hc = sl(hess, i * chunk_rows, chunk_rows)
-        mc = sl(included, i * chunk_rows, chunk_rows)
-        lc = sl(leaf_id, i * chunk_rows, chunk_rows)
+        if compact:
+            idx = sl(row_idx, i * chunk_rows, chunk_rows)
+            valid = (i * chunk_rows + iota_chunk) < n_active
+            xc = jnp.take(X, idx, axis=0)
+            gc = jnp.take(grad, idx)
+            hc = jnp.take(hess, idx)
+            mc = jnp.take(included, idx)
+            lc = jnp.take(leaf_id, idx)
+            slot = jnp.where(valid, slot_of_leaf[lc], -1)          # [R]
+        else:
+            xc = sl(X, i * chunk_rows, chunk_rows)
+            gc = sl(grad, i * chunk_rows, chunk_rows)
+            hc = sl(hess, i * chunk_rows, chunk_rows)
+            mc = sl(included, i * chunk_rows, chunk_rows)
+            lc = sl(leaf_id, i * chunk_rows, chunk_rows)
+            slot = slot_of_leaf[lc]                                # [R]
 
-        slot = slot_of_leaf[lc]                                   # [R]
         slot_onehot = (slot[:, None] == iota_slots)               # [R, S] bool
         g_hi, g_lo = _split_hi_lo(gc)
         h_hi, h_lo = _split_hi_lo(hc)
@@ -80,10 +123,23 @@ def build_histograms(
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                         # [F, B, S*ch]
-        return acc + part, ()
+        return acc + part
 
     acc0 = jnp.zeros((num_features, num_bins_padded, num_slots * ch), jnp.float32)
-    acc, _ = jax.lax.scan(chunk_body, acc0, jnp.arange(n_chunks))
+    if compact:
+        n_chunks_active = jnp.minimum(
+            (n_active + chunk_rows - 1) // chunk_rows, n_chunks)
+
+        def while_body(carry):
+            i, acc = carry
+            return i + 1, chunk_part(i, acc)
+
+        _, acc = jax.lax.while_loop(
+            lambda c: c[0] < n_chunks_active, while_body,
+            (jnp.asarray(0, n_chunks_active.dtype), acc0))
+    else:
+        acc, _ = jax.lax.scan(lambda a, i: (chunk_part(i, a), ()), acc0,
+                              jnp.arange(n_chunks))
 
     acc = acc.reshape(num_features, num_bins_padded, num_slots, ch)
     acc = jnp.transpose(acc, (2, 0, 1, 3))                        # [S, F, B, ch]

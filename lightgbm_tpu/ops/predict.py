@@ -13,15 +13,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..grower import TreeArrays
+from ..grower import TreeArrays, decode_bundled_bin
 
 
 def leaves_from_binned(
     tree: TreeArrays,
-    Xb: jnp.ndarray,            # [N, F] bin codes
+    Xb: jnp.ndarray,            # [N, F] bin codes ([N, G] bundled under EFB)
     num_bins: jnp.ndarray,      # [F] i32
     missing_code: jnp.ndarray,  # [F] i32
     default_bin: jnp.ndarray,   # [F] i32
+    bundle=None,                # grower.BundleDecode when Xb is EFB-bundled
 ) -> jnp.ndarray:
     """Leaf index [N] for each row."""
     N = Xb.shape[0]
@@ -43,7 +44,10 @@ def leaves_from_binned(
         f = tree.split_feature[nid]
         thr = tree.threshold_bin[nid]
         dl = tree.default_left[nid]
-        b = jnp.take_along_axis(Xb, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        if bundle is None:
+            b = jnp.take_along_axis(Xb, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        else:
+            b = decode_bundled_bin(Xb, f, bundle, default_bin)
         mcode = missing_code[f]
         nbin = num_bins[f]
         dbin = default_bin[f]

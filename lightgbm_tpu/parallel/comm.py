@@ -218,14 +218,28 @@ class VotingParallelComm:
                          is_cat, jnp.asarray(0, jnp.int32))
 
     def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
+        import dataclasses
+
         S, F, B, C = hist.shape
         k = max(1, min(self.top_k, F))
         k2 = min(2 * k, F)
 
-        # Phase 1 — local proposals. Parent sums are global here, matching the
-        # reference (local scans use global min_data constraints via
-        # smaller_leaf_splits_global_, voting_parallel_tree_learner.cpp:317).
-        pf_local, _ = block_per_feature(hist, pg, ph, pc, bm, spec)
+        # Phase 1 — local proposals from LOCAL leaf sums (the histogram here
+        # is this device's un-reduced partial, so its bin sums ARE the local
+        # leaf sums) with min_data/min_hessian constraints divided by the
+        # device count — mirroring the reference's local_tree_config_
+        # (voting_parallel_tree_learner.cpp:54-56) and smaller_leaf_splits_
+        # initialized from the local partition (:286-293).
+        local_pg = jnp.sum(hist[:, 0, :, 0], axis=-1)             # [S]
+        local_ph = jnp.sum(hist[:, 0, :, 1], axis=-1)
+        local_pc = jnp.sum(hist[:, 0, :, 2], axis=-1)
+        local_spec = dataclasses.replace(
+            spec,
+            min_data_in_leaf=spec.min_data_in_leaf / self.num_devices,
+            min_sum_hessian_in_leaf=(spec.min_sum_hessian_in_leaf
+                                     / self.num_devices))
+        pf_local, _ = block_per_feature(hist, local_pg, local_ph, local_pc,
+                                        bm, local_spec)
         local_gain = pf_local.gain
         top_gain, top_feat = jax.lax.top_k(local_gain, k)           # [S, k]
         votes = jnp.zeros((S, F), jnp.float32).at[

@@ -1,0 +1,123 @@
+"""EFB exclusive feature bundling tests
+(reference: Dataset::Construct FindGroups/FastFeatureBundling,
+src/io/dataset.cpp:66-295; encoding feature_group.h:30-52)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.efb import plan_bundles
+
+
+def _mixed_sparse_data(n=2000, dense=4, flag_groups=3, flags_per_group=20,
+                       seed=3):
+    """Few dense features + many mutually-exclusive binary flags (the one-hot
+    regime EFB was built for). Flags within a group are exclusive; flags in
+    different groups conflict on every row."""
+    rng = np.random.RandomState(seed)
+    Xd = rng.rand(n, dense)
+    flags = np.zeros((n, flag_groups * flags_per_group))
+    picks = rng.randint(0, flags_per_group, size=(n, flag_groups))
+    for g in range(flag_groups):
+        flags[np.arange(n), g * flags_per_group + picks[:, g]] = 1.0
+    X = np.concatenate([Xd, flags], axis=1)
+    y = (Xd[:, 0] + 0.3 * (picks[:, 0] > flags_per_group // 2)
+         + 0.1 * rng.randn(n) > 0.65).astype(np.float64)
+    return X, y, dense, flag_groups
+
+
+def _constructed(X, y, **params):
+    ds = lgb.Dataset(X, label=y)
+    ds.construct(Config.from_params(dict(verbose=-1, **params)))
+    return ds.constructed
+
+
+def test_plan_bundles_exclusive_flags():
+    X, y, dense, flag_groups = _mixed_sparse_data()
+    cd = _constructed(X, y)
+    meta = cd.feature_meta_arrays()
+    plan = plan_bundles(cd.X_binned, meta["num_bins"].astype(np.int64),
+                        meta["default_bin"].astype(np.int64), cd.config)
+    assert plan is not None
+    # 64 features collapse to ~dense singletons + ~one bundle per flag group
+    assert plan.num_groups <= dense + flag_groups + 2, plan.num_groups
+    # zero-conflict data: decode must round-trip every (row, feature) bin
+    for f in range(cd.num_features):
+        c = plan.X_bundled[:, plan.col[f]].astype(np.int64)
+        in_rng = (c >= plan.lo[f]) & (c < plan.hi[f])
+        dec = np.where(in_rng, c - plan.off[f], meta["default_bin"][f])
+        np.testing.assert_array_equal(dec, cd.X_binned[:, f],
+                                      err_msg=f"feature {f}")
+
+
+def test_unpack_map_consistency():
+    X, y, _, _ = _mixed_sparse_data()
+    cd = _constructed(X, y)
+    meta = cd.feature_meta_arrays()
+    plan = plan_bundles(cd.X_binned, meta["num_bins"].astype(np.int64),
+                        meta["default_bin"].astype(np.int64), cd.config)
+    assert plan is not None
+    for f in range(cd.num_features):
+        nb = int(meta["num_bins"][f])
+        db = int(meta["default_bin"][f])
+        for b in range(nb):
+            ub = plan.unpack_bin[f, b]
+            if b == db:
+                assert ub == -1            # always reconstructed (FixHistogram)
+            elif ub >= 0:
+                # unpack slot must be inside this feature's code range and
+                # decode back to b
+                assert plan.lo[f] <= ub < plan.hi[f]
+                assert ub - plan.off[f] == b
+
+
+def test_bundled_training_matches_unbundled():
+    X, y, _, _ = _mixed_sparse_data()
+    params = dict(objective="binary", num_leaves=15, min_data_in_leaf=5,
+                  device="cpu", verbose=-1)
+    b_on = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10,
+                     keep_training_booster=True, verbose_eval=False)
+    assert b_on._gbdt.bundle is not None, "EFB should trigger on this data"
+    assert b_on._gbdt.Xb.shape[1] < X.shape[1] // 2
+    b_off = lgb.train(dict(params, enable_bundle=False),
+                      lgb.Dataset(X, label=y), num_boost_round=10,
+                      verbose_eval=False)
+    p_on, p_off = b_on.predict(X), b_off.predict(X)
+    # zero-conflict bundles reproduce the same histograms up to the
+    # default-bin reconstruction rounding -> near-identical models
+    assert np.mean((p_on > 0.5) == (y > 0.5)) > 0.85
+    np.testing.assert_allclose(p_on, p_off, rtol=0.0, atol=5e-3)
+
+
+def test_dense_data_skips_bundling():
+    rng = np.random.RandomState(0)
+    X = rng.rand(500, 8)
+    y = (X[:, 0] > 0.5).astype(float)
+    bst = lgb.train(dict(objective="binary", verbose=-1, device="cpu"),
+                    lgb.Dataset(X, label=y), num_boost_round=2,
+                    keep_training_booster=True, verbose_eval=False)
+    assert bst._gbdt.bundle is None
+
+
+def test_conflict_rate_allows_near_exclusive():
+    """max_conflict_rate > 0 admits features that collide on a few rows
+    (reference max_error_cnt, dataset.cpp:152)."""
+    rng = np.random.RandomState(1)
+    n, F = 3000, 30
+    X = np.zeros((n, F))
+    picks = rng.randint(0, F, size=n)
+    X[np.arange(n), picks] = rng.rand(n) + 0.5
+    # ~2% fully-dense rows -> EVERY feature pair conflicts on ~2% of rows
+    dense_rows = rng.choice(n, n // 50, replace=False)
+    X[dense_rows] = rng.rand(len(dense_rows), F) + 0.5
+    y = (picks % 2).astype(float)
+    cd0 = _constructed(X, y, max_conflict_rate=0.0)
+    meta = cd0.feature_meta_arrays()
+    p0 = plan_bundles(cd0.X_binned, meta["num_bins"].astype(np.int64),
+                      meta["default_bin"].astype(np.int64), cd0.config)
+    cd1 = _constructed(X, y, max_conflict_rate=0.05)
+    p1 = plan_bundles(cd1.X_binned, meta["num_bins"].astype(np.int64),
+                      meta["default_bin"].astype(np.int64), cd1.config)
+    n0 = p0.num_groups if p0 is not None else F
+    assert p1 is not None
+    assert p1.num_groups < n0
