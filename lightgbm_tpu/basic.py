@@ -37,19 +37,32 @@ class Dataset:
                  categorical_feature: Union[str, List] = "auto",
                  params: Optional[Dict[str, Any]] = None,
                  free_raw_data: bool = False, silent: bool = False):
+        self._binary_path: Optional[str] = None
+        self._stream_path: Optional[str] = None
         if isinstance(data, str):
-            from .io.file_io import load_data_file
-            data, file_label, side = load_data_file(data, params or {})
-            if label is None:
-                label = file_label
-            if weight is None:
-                weight = side.get("weight")
-            if group is None:
-                group = side.get("group")
-            if init_score is None:
-                init_score = side.get("init_score")
-            if feature_name == "auto" and side.get("feature_names"):
-                feature_name = side["feature_names"]
+            from .config import resolve_aliases
+            from .io.file_io import is_binary_dataset, load_data_file
+            resolved = resolve_aliases(dict(params or {}))
+            if is_binary_dataset(data):
+                # binary dataset auto-detect (dataset_loader.cpp:265)
+                self._binary_path = data
+                data = np.zeros((0, 1))
+            elif resolved.get("use_two_round_loading"):
+                # two-round streaming load, deferred to construct()
+                self._stream_path = data
+                data = np.zeros((0, 1))
+            else:
+                data, file_label, side = load_data_file(data, resolved)
+                if label is None:
+                    label = file_label
+                if weight is None:
+                    weight = side.get("weight")
+                if group is None:
+                    group = side.get("group")
+                if init_score is None:
+                    init_score = side.get("init_score")
+                if feature_name == "auto" and side.get("feature_names"):
+                    feature_name = side["feature_names"]
         self.raw_data, inferred_names = _to_2d_float(data)
         self.label = None if label is None else np.asarray(label).reshape(-1)
         self.reference = reference
@@ -67,6 +80,20 @@ class Dataset:
 
     def construct(self, config: Optional[Config] = None) -> "Dataset":
         if self._constructed is not None or self._binned_aligned is not None:
+            return self
+        if self._binary_path is not None:
+            self._constructed = ConstructedDataset.load_binary(self._binary_path)
+            self.label = self._constructed.metadata.label
+            return self
+        if self._stream_path is not None:
+            from .io.file_io import stream_construct_dataset
+            cfg = config or Config.from_params(self.params)
+            self._constructed = stream_construct_dataset(
+                self._stream_path, cfg,
+                feature_names=None if self.feature_name in (None, "auto")
+                else self.feature_name,
+                categorical_features=self.categorical_feature)
+            self.label = self._constructed.metadata.label
             return self
         if self.reference is not None:
             ref = self.reference
@@ -99,11 +126,15 @@ class Dataset:
     # -- introspection (reference basic.py Dataset API) ----------------------
 
     def num_data(self) -> int:
+        if self._constructed is None and (self._binary_path or self._stream_path):
+            self.construct()
         if self._constructed is not None:
             return self._constructed.num_data
         return self.raw_data.shape[0]
 
     def num_feature(self) -> int:
+        if self._constructed is None and (self._binary_path or self._stream_path):
+            self.construct()
         if self._constructed is not None:
             return self._constructed.num_total_features
         return self.raw_data.shape[1]

@@ -1,12 +1,18 @@
-"""Text data loading: CSV / TSV / LibSVM with auto-detection.
+"""Text data loading: CSV / TSV / LibSVM with auto-detection, at scale.
 
-Reference: src/io/parser.{cpp,hpp} (CreateParser format sniffing), plus the
-side-file conventions of src/io/metadata.cpp / dataset_loader.cpp:
-`<data>.query` (query group sizes), `<data>.weight`, `<data>.init` (initial
-scores) are picked up automatically when present.
+Reference: src/io/parser.{cpp,hpp} (CreateParser format sniffing) and
+src/io/dataset_loader.cpp:
+- column specs by index or ``name:`` for label/weight/group/ignore
+  (dataset_loader.cpp column resolution, dataset.h:36-248 Metadata columns),
+- side files ``<data>.query`` / ``.weight`` / ``.init`` picked up when
+  present (metadata.cpp conventions),
+- two-round loading for big files (dataset_loader.cpp:159-265): round one
+  streams the file to sample rows for bin finding, round two streams again
+  pushing bin codes straight into the binned matrix — peak memory is one
+  chunk of floats plus the uint8/16 bin matrix, never the full float matrix.
 
-Host-side preprocessing in NumPy; a native C++ parser is the planned
-replacement for very large files (reference's is C++ too).
+The chunked text parser is pandas' C reader — the Python-stack equivalent of
+the reference's OMP row-parallel C++ Parser (dataset_loader.cpp:906-1101).
 """
 from __future__ import annotations
 
@@ -16,6 +22,9 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..utils.log import Log
+
+_NA_VALUES = ["", "na", "NA", "nan", "NaN", "null", "N/A"]
+_CHUNK_ROWS = 1 << 19
 
 
 def _sniff_format(sample_lines: List[str]) -> str:
@@ -33,7 +42,72 @@ def _sniff_format(sample_lines: List[str]) -> str:
     return "tsv"
 
 
-def _parse_libsvm(lines: List[str]) -> Tuple[np.ndarray, np.ndarray]:
+def _head_lines(path: str, n: int = 20) -> List[str]:
+    out = []
+    with open(path, "r") as fh:
+        for _ in range(n):
+            line = fh.readline()
+            if not line:
+                break
+            out.append(line.rstrip("\n"))
+    return out
+
+
+def is_binary_dataset(path: str) -> bool:
+    """Binary dataset auto-detect (reference: token check on load,
+    dataset_loader.cpp:265 LoadFromBinFile)."""
+    try:
+        with open(path, "rb") as fh:
+            head = fh.read(4096)
+    except OSError:
+        return False
+    return head[:1] == b"\x80" and b"lightgbm_tpu.dataset" in head
+
+
+def _resolve_col(spec: str, header: Optional[List[str]], default: int = -1) -> int:
+    spec = str(spec or "").strip()
+    if not spec:
+        return default
+    if spec.startswith("name:"):
+        if header is None:
+            Log.fatal("Column spec %s requires has_header=true", spec)
+        name = spec[5:]
+        if name not in header:
+            Log.fatal("Column name %s not found in header", name)
+        return header.index(name)
+    return int(spec)
+
+
+def _resolve_cols(spec: str, header: Optional[List[str]]) -> List[int]:
+    if not spec:
+        return []
+    return [_resolve_col(tok, header) for tok in str(spec).split(",") if tok.strip()]
+
+
+def _group_ids_to_sizes(ids: np.ndarray) -> np.ndarray:
+    """Query-id column -> per-query sizes (reference metadata.cpp: rows with
+    the same consecutive query id form one group)."""
+    if len(ids) == 0:
+        return np.zeros(0, np.int64)
+    change = np.nonzero(np.diff(ids))[0]
+    bounds = np.concatenate([[0], change + 1, [len(ids)]])
+    return np.diff(bounds)
+
+
+def _read_chunks(path: str, fmt: str, has_header: bool):
+    """Yield float64 [rows, cols] chunks via pandas' C parser."""
+    import pandas as pd
+    sep = "\t" if fmt == "tsv" else ","
+    reader = pd.read_csv(path, sep=sep, header=None,
+                         skiprows=1 if has_header else 0,
+                         na_values=_NA_VALUES, keep_default_na=True,
+                         dtype=np.float64, chunksize=_CHUNK_ROWS,
+                         engine="c")
+    for chunk in reader:
+        yield chunk.to_numpy(dtype=np.float64, copy=False)
+
+
+def _parse_libsvm(lines) -> Tuple[np.ndarray, np.ndarray]:
     labels = []
     rows = []
     max_idx = -1
@@ -57,42 +131,181 @@ def _parse_libsvm(lines: List[str]) -> Tuple[np.ndarray, np.ndarray]:
     return X, np.asarray(labels, dtype=np.float64)
 
 
-def load_data_file(path: str, params: Dict) -> Tuple[np.ndarray, Optional[np.ndarray], Dict]:
-    """Returns (features, label, side_metadata). Label column handling follows
-    the reference: default column 0, or `label_column` index / `name:` spec."""
-    with open(path, "r") as fh:
-        lines = fh.read().splitlines()
+def _split_columns(mat: np.ndarray, header: Optional[List[str]], params: Dict
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray], Dict,
+                              Optional[List[str]]]:
+    """Extract label/weight/group columns (file coordinates) from a parsed
+    matrix; returns (features, label, side, feature_names)."""
+    label_idx = _resolve_col(params.get("label_column", ""), header, default=0)
+    weight_idx = _resolve_col(params.get("weight_column", ""), header)
+    group_idx = _resolve_col(params.get("group_column", ""), header)
+    ignore = set(_resolve_cols(params.get("ignore_column", ""), header))
+
+    side: Dict = {}
+    label = mat[:, label_idx] if label_idx >= 0 else None
+    if weight_idx >= 0:
+        side["weight"] = mat[:, weight_idx]
+    if group_idx >= 0:
+        side["group"] = _group_ids_to_sizes(mat[:, group_idx])
+    drop = sorted({label_idx} | ({weight_idx} if weight_idx >= 0 else set())
+                  | ({group_idx} if group_idx >= 0 else set()) | ignore
+                  - {-1})
+    drop = [d for d in drop if d >= 0]
+    keep = [j for j in range(mat.shape[1]) if j not in drop]
+    X = mat[:, keep]
+    names = None if header is None else [header[j] for j in keep]
+    return X, label, side, names
+
+
+def load_data_file(path: str, params: Dict
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray], Dict]:
+    """Returns (features, label, side_metadata).
+
+    Label column handling follows the reference: default column 0, or
+    ``label_column`` index / ``name:`` spec; ``weight_column`` /
+    ``group_column`` / ``ignore_column`` extract in-file metadata columns
+    (reference dataset.h:36-248 Metadata init from columns).
+    """
     has_header = bool(params.get("has_header") or params.get("header"))
+    head = _head_lines(path)
+    fmt = _sniff_format(head[1 if has_header else 0:])
+
     header_names: Optional[List[str]] = None
-    fmt = _sniff_format(lines[:20][1 if has_header else 0:])
     if has_header and fmt != "libsvm":
         sep = "\t" if fmt == "tsv" else ","
-        header_names = [t.strip() for t in lines[0].split(sep)]
-        lines = lines[1:]
+        header_names = [t.strip() for t in head[0].split(sep)]
 
     if fmt == "libsvm":
-        X, label = _parse_libsvm(lines)
+        with open(path, "r") as fh:
+            X, label = _parse_libsvm(fh)
+        side: Dict = {}
+        names = None
     else:
-        sep = "\t" if fmt == "tsv" else ","
-        mat = np.array(
-            [[float(v) if v not in ("", "na", "NA", "nan", "NaN", "null") else np.nan
-              for v in line.split(sep)]
-             for line in lines if line.strip()], dtype=np.float64)
-        label_spec = str(params.get("label_column", "") or "0")
-        if label_spec.startswith("name:"):
-            if header_names is None:
-                Log.fatal("label_column name: spec requires has_header=true")
-            label_idx = header_names.index(label_spec[5:])
-        else:
-            label_idx = int(label_spec)
-        label = mat[:, label_idx]
-        X = np.delete(mat, label_idx, axis=1)
-        if header_names is not None:
-            header_names = [h for i, h in enumerate(header_names) if i != label_idx]
+        chunks = list(_read_chunks(path, fmt, has_header))
+        mat = np.vstack(chunks) if len(chunks) != 1 else chunks[0]
+        del chunks
+        X, label, side, names = _split_columns(mat, header_names, params)
 
-    side: Dict = {"feature_names": header_names}
-    for suffix, key in ((".query", "group"), (".weight", "weight"), (".init", "init_score")):
+    side.setdefault("feature_names", names)
+    for suffix, key in ((".query", "group"), (".weight", "weight"),
+                        (".init", "init_score")):
         side_path = path + suffix
-        if os.path.exists(side_path):
+        if os.path.exists(side_path) and key not in side:
             side[key] = np.loadtxt(side_path, dtype=np.float64)
     return X, label, side
+
+
+def stream_construct_dataset(path: str, config, feature_names=None,
+                             categorical_features=None):
+    """Two-round streaming construction (use_two_round_loading=true;
+    reference dataset_loader.cpp:159-265):
+
+    round 1: stream chunks, reservoir-sample rows for bin finding, count rows;
+    round 2: stream again, push per-chunk bin codes into the preallocated
+    binned matrix. Peak memory = one float chunk + the uint8/16 bin matrix.
+    """
+    from ..binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
+    from ..dataset import ConstructedDataset, FeatureInfo, Metadata
+
+    params = config.to_dict() if hasattr(config, "to_dict") else dict(config)
+    has_header = bool(params.get("has_header"))
+    head = _head_lines(path)
+    fmt = _sniff_format(head[1 if has_header else 0:])
+    if fmt == "libsvm":
+        Log.fatal("two-round loading supports csv/tsv only")
+    header_names: Optional[List[str]] = None
+    if has_header:
+        sep = "\t" if fmt == "tsv" else ","
+        header_names = [t.strip() for t in head[0].split(sep)]
+
+    sample_cnt = int(params.get("bin_construct_sample_cnt", 200000))
+    rng = np.random.RandomState(int(params.get("data_random_seed", 1)))
+
+    # ---- round 1: reservoir sample + row count (vectorized algorithm R:
+    # each later row replaces a random reservoir slot w.p. sample/t) --------
+    reservoir = None
+    n_seen = 0
+    for mat in _read_chunks(path, fmt, has_header):
+        if reservoir is None:
+            reservoir = mat[:sample_cnt].copy()
+            rest = mat[sample_cnt:]
+            n_seen = len(reservoir)
+        else:
+            rest = mat
+        if len(rest):
+            t = n_seen + np.arange(1, len(rest) + 1)
+            accept = rng.random_sample(len(rest)) < (sample_cnt / t)
+            picked = rest[accept]
+            if len(picked):
+                slots = rng.randint(0, sample_cnt, size=len(picked))
+                reservoir[slots] = picked
+            n_seen += len(rest)
+    if reservoir is None:
+        Log.fatal("Empty data file %s", path)
+    total_rows = n_seen
+
+    Xs, label_s, side_s, names = _split_columns(reservoir, header_names, params)
+    num_total_features = Xs.shape[1]
+    if feature_names is None:
+        feature_names = names or [f"Column_{i}" for i in range(num_total_features)]
+
+    cat_set = set()
+    if categorical_features is not None:
+        for c in categorical_features:
+            cat_set.add(feature_names.index(c) if isinstance(c, str) else int(c))
+    from ..dataset import _parse_column_spec
+    cat_set.update(_parse_column_spec(config.categorical_column, feature_names))
+
+    sample_n = Xs.shape[0]
+    filter_cnt = int(config.min_data_in_leaf * sample_n / max(total_rows, 1))
+    features: List[FeatureInfo] = []
+    for j in range(num_total_features):
+        mapper = BinMapper()
+        bin_type = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
+        mapper.find_bin(Xs[:, j], sample_n, config.max_bin,
+                        config.min_data_in_bin, filter_cnt, bin_type,
+                        config.use_missing, config.zero_as_missing)
+        if not mapper.is_trivial:
+            features.append(FeatureInfo(j, mapper))
+    if not features:
+        Log.warning("There are no meaningful features in %s", path)
+
+    dtype = np.uint8 if all(f.mapper.num_bin <= 256 for f in features) else np.uint16
+    X_binned = np.zeros((total_rows, max(len(features), 1)), dtype=dtype)
+    label = np.zeros(total_rows, np.float64)
+    weight = np.zeros(total_rows, np.float64) if "weight" in side_s else None
+    group_ids = np.zeros(total_rows, np.float64) if "group" in side_s else None
+
+    # ---- round 2: bin per chunk -------------------------------------------
+    group_col = _resolve_col(params.get("group_column", ""), header_names)
+    row0 = 0
+    for mat in _read_chunks(path, fmt, has_header):
+        Xc, lab_c, side_c, _ = _split_columns(mat, header_names, params)
+        r = slice(row0, row0 + len(Xc))
+        for inner, f in enumerate(features):
+            X_binned[r, inner] = f.mapper.value_to_bin(
+                Xc[:, f.real_index]).astype(dtype)
+        if lab_c is not None:
+            label[r] = lab_c
+        if weight is not None:
+            weight[r] = side_c["weight"]
+        if group_ids is not None:
+            group_ids[r] = mat[:, group_col]
+        row0 += len(Xc)
+
+    metadata = Metadata(total_rows)
+    metadata.set_label(label)
+    if weight is not None:
+        metadata.set_weight(weight)
+    if group_ids is not None:
+        metadata.set_group(_group_ids_to_sizes(group_ids))
+    else:
+        qpath = path + ".query"
+        if os.path.exists(qpath):
+            metadata.set_group(np.loadtxt(qpath, dtype=np.int64))
+        wpath = path + ".weight"
+        if os.path.exists(wpath) and weight is None:
+            metadata.set_weight(np.loadtxt(wpath, dtype=np.float64))
+
+    return ConstructedDataset(X_binned, features, num_total_features, metadata,
+                              feature_names, config)

@@ -1,0 +1,134 @@
+"""Scalable data loading tests
+(reference: src/io/dataset_loader.cpp two-round loading :159-265, in-file
+metadata columns dataset.h:36-248, binary auto-detect :265)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.file_io import (_group_ids_to_sizes, is_binary_dataset,
+                                     load_data_file, stream_construct_dataset)
+
+
+def _write_csv(path, mat, header=None):
+    with open(path, "w") as fh:
+        if header:
+            fh.write(",".join(header) + "\n")
+        np.savetxt(fh, mat, delimiter=",", fmt="%.6g")
+
+
+def test_group_ids_to_sizes():
+    ids = np.array([1, 1, 1, 4, 4, 2, 2, 2, 2])
+    np.testing.assert_array_equal(_group_ids_to_sizes(ids), [3, 2, 4])
+
+
+def test_weight_group_ignore_columns_by_index(tmp_path):
+    rng = np.random.RandomState(0)
+    n = 40
+    feats = rng.rand(n, 3)
+    label = rng.randint(0, 2, n).astype(float)
+    weight = rng.rand(n) + 0.5
+    qid = np.repeat([0, 1, 2, 3], 10).astype(float)
+    junk = np.full(n, 7.0)
+    # file layout: label, f0, weight, f1, qid, junk, f2
+    mat = np.column_stack([label, feats[:, 0], weight, feats[:, 1], qid,
+                           junk, feats[:, 2]])
+    p = str(tmp_path / "d.csv")
+    _write_csv(p, mat)
+    X, lab, side = load_data_file(p, {"label_column": "0", "weight_column": "2",
+                                      "group_column": "4", "ignore_column": "5"})
+    np.testing.assert_allclose(lab, label, rtol=1e-5)
+    np.testing.assert_allclose(X, feats, rtol=1e-5)
+    np.testing.assert_allclose(side["weight"], weight, rtol=1e-5)
+    np.testing.assert_array_equal(side["group"], [10, 10, 10, 10])
+
+
+def test_columns_by_name_with_header(tmp_path):
+    rng = np.random.RandomState(1)
+    n = 30
+    mat = np.column_stack([rng.rand(n), rng.randint(0, 2, n).astype(float),
+                           rng.rand(n)])
+    p = str(tmp_path / "h.csv")
+    _write_csv(p, mat, header=["w", "target", "x0"])
+    X, lab, side = load_data_file(
+        p, {"has_header": True, "label_column": "name:target",
+            "weight_column": "name:w"})
+    np.testing.assert_allclose(lab, mat[:, 1], rtol=1e-5)
+    np.testing.assert_allclose(side["weight"], mat[:, 0], rtol=1e-5)
+    assert side["feature_names"] == ["x0"]
+    assert X.shape == (n, 1)
+
+
+def test_two_round_matches_in_memory(tmp_path):
+    rng = np.random.RandomState(2)
+    n = 5000
+    feats = rng.randn(n, 6)
+    label = (feats[:, 0] > 0).astype(float)
+    mat = np.column_stack([label, feats])
+    p = str(tmp_path / "big.csv")
+    _write_csv(p, mat)
+
+    cfg = Config.from_params({"verbose": -1})
+    cd_stream = stream_construct_dataset(p, cfg)
+    ds_mem = lgb.Dataset(p)
+    ds_mem.construct(cfg)
+    cd_mem = ds_mem.constructed
+
+    assert cd_stream.num_data == cd_mem.num_data == n
+    assert cd_stream.num_features == cd_mem.num_features
+    np.testing.assert_allclose(cd_stream.metadata.label, cd_mem.metadata.label,
+                               rtol=1e-5)
+    # bin boundaries come from different samples only when n > sample_cnt;
+    # here both see all rows, so binned matrices must agree exactly
+    np.testing.assert_array_equal(cd_stream.X_binned, cd_mem.X_binned)
+
+
+def test_two_round_via_dataset_param(tmp_path):
+    rng = np.random.RandomState(3)
+    n = 2000
+    feats = rng.randn(n, 4)
+    label = feats[:, 0] * 2 + 0.1 * rng.randn(n)
+    _write_csv(str(tmp_path / "t.csv"), np.column_stack([label, feats]))
+    ds = lgb.Dataset(str(tmp_path / "t.csv"), params={"two_round": True})
+    bst = lgb.train({"objective": "regression", "verbose": -1, "device": "cpu"},
+                    ds, num_boost_round=5, verbose_eval=False)
+    pred = bst.predict(feats)
+    assert np.mean((pred - label) ** 2) < np.var(label)
+
+
+def test_binary_autodetect_roundtrip(tmp_path):
+    rng = np.random.RandomState(4)
+    X = rng.randn(500, 5)
+    y = (X[:, 0] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct(Config.from_params({"verbose": -1}))
+    bin_path = str(tmp_path / "d.bin")
+    ds.save_binary(bin_path)
+    assert is_binary_dataset(bin_path)
+    assert not is_binary_dataset(__file__)
+
+    ds2 = lgb.Dataset(bin_path)
+    assert ds2.num_data() == 500
+    bst = lgb.train({"objective": "binary", "verbose": -1, "device": "cpu"},
+                    ds2, num_boost_round=5, verbose_eval=False)
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.85
+
+
+def test_chunked_load_speed(tmp_path):
+    """0.5M x 10 CSV parses via the chunked C reader in seconds, not minutes
+    (the round-1 per-line Python parser took minutes at this scale)."""
+    rng = np.random.RandomState(5)
+    n = 500_000
+    mat = np.column_stack([rng.randint(0, 2, n).astype(np.float32),
+                           rng.rand(n, 10).astype(np.float32)])
+    p = str(tmp_path / "big.csv")
+    _write_csv(p, mat)
+    t0 = time.perf_counter()
+    X, lab, _ = load_data_file(p, {})
+    dt = time.perf_counter() - t0
+    assert X.shape == (n, 10)
+    assert dt < 30, f"load took {dt:.1f}s"
