@@ -173,7 +173,6 @@ class GBDT:
             min_data_in_leaf=float(config.min_data_in_leaf),
             min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
             min_gain_to_split=config.min_gain_to_split,
-            num_block_features=self.pctx.block_features(F_pad),
             row_compact=config.tpu_row_compact,
             hist_bins=self._hist_bins,
             use_categorical=bool(meta["is_categorical"].any()),

@@ -131,8 +131,6 @@ class GrowerSpec:
     min_data_in_leaf: float
     min_sum_hessian_in_leaf: float
     min_gain_to_split: float
-    num_block_features: int = 0   # features this device SCANS (0 = num_features);
-                                  # < num_features under data-parallel psum_scatter
     row_compact: bool = True      # histogram only pending-leaf rows per wave
     hist_bins: int = 0            # bin axis of the histogram BUILD (EFB bundle
                                   # space); 0 = num_bins_padded (unbundled)
@@ -143,10 +141,6 @@ class GrowerSpec:
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
     min_data_per_group: float = 100.0
-
-    @property
-    def block_features(self) -> int:
-        return self.num_block_features or self.num_features
 
     def hyperparams(self) -> Dict[str, float]:
         return dict(lambda_l1=self.lambda_l1, lambda_l2=self.lambda_l2,
@@ -246,11 +240,15 @@ def grow_tree(
     L = spec.num_leaves
     M = L - 1
     S = spec.hist_slots
-    F = spec.block_features       # features scanned/cached on this device
     B = spec.num_bins_padded
     N = X.shape[0]
     X_hist = comm.hist_X(X)       # columns this device histograms
     F_hist = X_hist.shape[1]      # == F unless bundled (then G)
+    # Width AFTER comm.reduce_hist: under data-parallel the psum_scatter
+    # leaves each device only its F/D feature block (reference
+    # data_parallel_tree_learner.cpp:148-163) — the per-leaf cache, sibling
+    # subtraction, and split scan all live in that post-reduction space.
+    F_cache = comm.reduced_hist_features(F_hist)
     B_hist = spec.hist_bins or B  # bundle-space bin axis
     bm = comm.block_meta(feature_ok, num_bins, missing_code, default_bin, is_cat)
 
@@ -260,7 +258,7 @@ def grow_tree(
     state = GrowState(
         tree=tree,
         leaf_id=jnp.zeros(N, jnp.int32),
-        hist=jnp.zeros((L + 1, F_hist, B_hist, 3), jnp.float32),
+        hist=jnp.zeros((L + 1, F_cache, B_hist, 3), jnp.float32),
         sum_g=jnp.zeros(L + 1, jnp.float32).at[0].set(rg),
         sum_h=jnp.zeros(L + 1, jnp.float32).at[0].set(rh),
         cnt=jnp.zeros(L + 1, jnp.float32).at[0].set(rc),

@@ -115,6 +115,11 @@ class SerialComm:
         """[S, F_hist, B, 3] partial -> [S, F_block, B, 3] global sums."""
         return hist
 
+    def reduced_hist_features(self, F_hist: int) -> int:
+        """Feature width of ``reduce_hist``'s output — what the grower's
+        per-leaf histogram cache must be sized by (identity here)."""
+        return F_hist
+
     def block_meta(self, feature_ok, num_bins, missing_code, default_bin,
                    is_cat) -> BlockMeta:
         return BlockMeta(feature_ok, num_bins, missing_code, default_bin,
@@ -156,6 +161,12 @@ class DataParallelComm:
         return jax.lax.psum_scatter(blocks, self.axis, scatter_dimension=0,
                                     tiled=False)
 
+    def reduced_hist_features(self, F_hist: int) -> int:
+        # psum_scatter leaves each device holding only its feature block —
+        # the cache must be block-shaped (each rank owns its block,
+        # reference data_parallel_tree_learner.cpp:148-163)
+        return self.block
+
     def block_meta(self, feature_ok, num_bins, missing_code, default_bin,
                    is_cat) -> BlockMeta:
         i = jax.lax.axis_index(self.axis)
@@ -191,6 +202,7 @@ class FeatureParallelComm:
     def reduce_hist(self, hist):
         return hist                   # [S, F/D, B, 3] already global
 
+    reduced_hist_features = SerialComm.reduced_hist_features
     block_meta = DataParallelComm.block_meta
     find_splits = DataParallelComm.find_splits
 
@@ -211,6 +223,8 @@ class VotingParallelComm:
 
     def reduce_hist(self, hist):
         return hist                   # kept LOCAL; reduction happens per-vote
+
+    reduced_hist_features = SerialComm.reduced_hist_features
 
     def block_meta(self, feature_ok, num_bins, missing_code, default_bin,
                    is_cat) -> BlockMeta:
@@ -306,11 +320,6 @@ class ParallelContext:
     def pad_rows_multiple(self) -> int:
         """Row padding granularity (rows sharded -> multiple of D)."""
         return self.num_devices if self.strategy in ("data", "voting") else 1
-
-    def block_features(self, F_padded: int) -> int:
-        if self.strategy in ("data", "feature"):
-            return F_padded // self.num_devices
-        return F_padded
 
     # ---------------------------------------------------------------- comm
 
