@@ -1,0 +1,109 @@
+"""Round-3 profile: per-component cost of the wave loop at bench config.
+
+Measures on the real chip (N=2.1M, F=28, B=256, S=16 — the BENCH_r02 regime):
+  1. full-pass histogram, no compaction (scan, static trip count)
+  2. compacted histogram at several n_active fractions (dynamic while_loop)
+  3. compact_rows alone
+  4. split scan for 2S slots
+  5. grow_tree end-to-end, varying (row_compact, slots, chunk)
+
+Run: python exp/wave_profile.py [quick]
+"""
+import time
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.grower import GrowerSpec, grow_tree
+from lightgbm_tpu.ops.histogram import build_histograms, compact_rows
+from lightgbm_tpu.ops.split_finder import per_feature_best_numerical
+
+N = 2 ** 21
+F = 28
+B = 256
+L = 255
+S = 16
+rng = np.random.RandomState(0)
+quick = "quick" in sys.argv[1:]
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).sum()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).sum()
+    return (time.perf_counter() - t0) / reps
+
+
+X = rng.randint(0, B, size=(N, F)).astype(np.uint8)
+Xd = jnp.asarray(X)
+g = jnp.asarray(rng.randn(N).astype(np.float32))
+h = jnp.ones(N, jnp.float32)
+inc = jnp.ones(N, jnp.float32)
+num_bins = jnp.full(F, B, jnp.int32)
+missing_code = jnp.zeros(F, jnp.int32)
+default_bin = jnp.zeros(F, jnp.int32)
+fok = jnp.ones(F, bool)
+is_cat = jnp.zeros(F, bool)
+
+# leaf ids spread over 32 leaves so slot masks are realistic
+leaf_id_np = rng.randint(0, 32, size=N).astype(np.int32)
+leaf_id = jnp.asarray(leaf_id_np)
+
+chunk = 32768
+
+# ---- 1. full pass, no compaction --------------------------------------------
+slot_all = jnp.zeros(L + 1, jnp.int32).at[:].set(-1)
+slot_all = slot_all.at[jnp.arange(16)].set(jnp.arange(16))  # 16 of 32 leaves pending
+t = timeit(jax.jit(lambda lid: build_histograms(
+    Xd, g, h, inc, lid, slot_all, num_slots=S, num_bins_padded=B,
+    chunk_rows=chunk)), leaf_id)
+print(f"1. full-pass hist (scan, no compact)           : {t*1e3:8.1f} ms")
+
+# ---- 2. compacted at fractions ----------------------------------------------
+for n_pending_leaves in ([16, 4, 1] if not quick else [4]):
+    slot = jnp.full(L + 1, -1, jnp.int32).at[
+        jnp.arange(n_pending_leaves)].set(jnp.arange(n_pending_leaves))
+    frac = n_pending_leaves / 32
+
+    def run(lid, slot=slot):
+        ri, na = compact_rows(lid, slot)
+        return build_histograms(Xd, g, h, inc, lid, slot, num_slots=S,
+                                num_bins_padded=B, chunk_rows=chunk,
+                                row_idx=ri, n_active=na)
+    t = timeit(jax.jit(run), leaf_id)
+    print(f"2. compact hist, ~{frac:4.0%} rows active          : {t*1e3:8.1f} ms")
+
+# ---- 3. compact_rows alone --------------------------------------------------
+t = timeit(jax.jit(lambda lid: compact_rows(lid, slot_all)), leaf_id)
+print(f"3. compact_rows alone                          : {t*1e3:8.1f} ms")
+
+# ---- 4. split scan for 2S slots ---------------------------------------------
+hist = jnp.asarray(rng.rand(2 * S, F, B, 3).astype(np.float32))
+pg = jnp.sum(hist[:, 0, :, 0], axis=-1)
+ph = jnp.sum(hist[:, 0, :, 1], axis=-1)
+pc = jnp.sum(hist[:, 0, :, 2], axis=-1)
+t = timeit(jax.jit(lambda hh: per_feature_best_numerical(
+    hh, pg, ph, pc, num_bins, missing_code, default_bin, fok,
+    lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=100.0,
+    min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0)), hist)
+print(f"4. split scan 2S={2*S} slots                     : {t*1e3:8.1f} ms")
+
+# ---- 5. grow_tree end-to-end ------------------------------------------------
+configs = [(True, 16, 32768), (False, 16, 32768)]
+if not quick:
+    configs += [(True, 16, 131072), (True, 32, 32768), (True, 8, 32768)]
+for rc, slots, ch in configs:
+    spec = GrowerSpec(num_leaves=L, num_features=F, num_bins_padded=B,
+                      chunk_rows=ch, hist_slots=slots, wave_size=slots,
+                      max_depth=0, lambda_l1=0.0, lambda_l2=0.0,
+                      min_data_in_leaf=100.0, min_sum_hessian_in_leaf=1e-3,
+                      min_gain_to_split=0.0, row_compact=rc)
+    grow = jax.jit(lambda gg: grow_tree(Xd, gg, h, inc, fok, is_cat, num_bins,
+                                        missing_code, default_bin, spec))
+    t = timeit(grow, g, reps=3)
+    print(f"5. grow_tree compact={int(rc)} slots={slots:3d} chunk={ch:6d}: {t*1e3:8.1f} ms")

@@ -17,12 +17,15 @@ __version__ = "0.1.0"
 
 from .config import Config
 from .basic import Booster, Dataset
+from .utils.log import LightGBMError
 from .engine import train, cv
 from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
 from .sklearn import LGBMModel, LGBMClassifier, LGBMRegressor, LGBMRanker
+from .plotting import plot_importance, plot_metric, plot_tree, create_tree_digraph
 
 __all__ = [
     "Config",
+    "LightGBMError",
     "Dataset",
     "Booster",
     "train",
@@ -35,4 +38,8 @@ __all__ = [
     "LGBMClassifier",
     "LGBMRegressor",
     "LGBMRanker",
+    "plot_importance",
+    "plot_metric",
+    "plot_tree",
+    "create_tree_digraph",
 ]

@@ -14,9 +14,44 @@ from .tree import Tree
 from .utils.log import Log, LightGBMError
 
 
-def _to_2d_float(data) -> np.ndarray:
+def _is_sparse(data) -> bool:
+    """scipy CSR/CSC/COO duck-check without importing scipy."""
+    return hasattr(data, "tocsr") and hasattr(data, "tocsc")
+
+
+def _data_from_pandas(df, pandas_categorical=None):
+    """DataFrame -> float64 matrix, mapping `category` dtype columns to their
+    category codes (reference basic.py:226-268). At train time the per-column
+    category lists are recorded; at predict time the recorded lists re-map so
+    codes agree with training (unseen categories become NaN/missing).
+
+    Returns (array, feature_names, cat_col_names, pandas_categorical).
+    """
+    cat_cols = [c for c in df.columns if str(df[c].dtype) == "category"]
+    if pandas_categorical is None:                    # training
+        pandas_categorical = [list(df[c].cat.categories) for c in cat_cols]
+    elif len(cat_cols) != len(pandas_categorical):
+        raise ValueError("train and predict data have different categorical "
+                         "columns")
+    if cat_cols:
+        df = df.copy()
+        for c, cats in zip(cat_cols, pandas_categorical):
+            codes = df[c].cat.set_categories(cats).cat.codes.astype(np.float64)
+            df[c] = codes.where(codes >= 0, np.nan)   # unseen/NaN -> missing
+    arr = df.values.astype(np.float64, copy=False)
+    return arr, [str(c) for c in df.columns], [str(c) for c in cat_cols], \
+        pandas_categorical
+
+
+def _to_2d_float(data):
     if hasattr(data, "values") and hasattr(data, "columns"):  # pandas DataFrame
-        return data.values.astype(np.float64, copy=False), [str(c) for c in data.columns]
+        arr, names, _, _ = _data_from_pandas(data)
+        return arr, names
+    if _is_sparse(data):
+        # keep sparse: binning densifies to uint8 bin codes columnwise
+        # without ever materializing the float matrix (reference accepts
+        # CSR/CSC via LGBM_DatasetCreateFromCSR/CSC, c_api.cpp:471+)
+        return data.tocsr(), None
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(1, -1)
@@ -63,7 +98,14 @@ class Dataset:
                     init_score = side.get("init_score")
                 if feature_name == "auto" and side.get("feature_names"):
                     feature_name = side["feature_names"]
-        self.raw_data, inferred_names = _to_2d_float(data)
+        self.pandas_categorical = None
+        if hasattr(data, "values") and hasattr(data, "columns"):   # DataFrame
+            arr, names, cat_cols, self.pandas_categorical = _data_from_pandas(data)
+            self.raw_data, inferred_names = arr, names
+            if categorical_feature == "auto" and cat_cols:
+                categorical_feature = cat_cols
+        else:
+            self.raw_data, inferred_names = _to_2d_float(data)
         self.label = None if label is None else np.asarray(label).reshape(-1)
         self.reference = reference
         self.weight = weight
@@ -189,14 +231,29 @@ class Dataset:
             is_arr = np.asarray(self.init_score)
             init_score = is_arr[idx] if is_arr.ndim == 1 and len(is_arr) == self.num_data() \
                 else is_arr
+        group = None
         if self.group is not None:
-            # row-level subsetting would break query structure; callers doing
-            # ranking CV must fold at query granularity (engine.cv handles it)
-            Log.fatal("Cannot subset a Dataset with group/query information by rows")
+            # Grouped data subsets at query granularity only (reference
+            # engine.py _make_n_folds folds by group): every query must be
+            # entirely in or out of `used_indices`, and rows of a query must
+            # stay together so the new group array is well-formed.
+            sizes = np.asarray(self.group, dtype=np.int64)
+            qid = np.repeat(np.arange(len(sizes)), sizes)        # row -> query
+            if len(qid) != self.num_data():
+                Log.fatal("group sizes do not sum to num_data")
+            take = np.zeros(len(sizes), bool)
+            take[np.unique(qid[idx])] = True
+            full = np.flatnonzero(take)
+            if len(idx) != int(sizes[full].sum()) or np.any(np.diff(qid[idx]) < 0):
+                Log.fatal("Cannot subset a grouped Dataset except by whole "
+                          "queries in query order (ranking cv folds at query "
+                          "granularity)")
+            group = sizes[full]
         return Dataset(self.raw_data[idx],
                        label=None if self.label is None else self.label[idx],
                        weight=None if self.weight is None else np.asarray(self.weight)[idx],
                        init_score=init_score,
+                       group=group,
                        params=params or self.params,
                        feature_name=self.feature_name,
                        categorical_feature=self.categorical_feature)
@@ -249,6 +306,7 @@ class Booster:
         self.mappers = cd.mappers
         self._real_feature_idx = cd.real_feature_idx
         self.num_model_per_iteration = self._gbdt.num_models
+        self.pandas_categorical = getattr(train_set, "pandas_categorical", None)
 
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         data.construct(self.config)
@@ -346,7 +404,20 @@ class Booster:
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
         if hasattr(data, "values") and hasattr(data, "columns"):
-            data = data.values
+            data, _, _, _ = _data_from_pandas(data, self.pandas_categorical)
+        if _is_sparse(data):
+            # chunked densify bounds peak memory; tree traversal is
+            # vectorized over dense rows (reference Predictor handles CSR
+            # rows natively, predictor.hpp:25-241)
+            csr = data.tocsr()
+            chunk = max(1, (1 << 24) // max(csr.shape[1], 1))
+            if csr.shape[0] > chunk:
+                parts = [self.predict(csr[i:i + chunk], num_iteration=num_iteration,
+                                      raw_score=raw_score, pred_leaf=pred_leaf,
+                                      pred_contrib=pred_contrib, **kwargs)
+                         for i in range(0, csr.shape[0], chunk)]
+                return np.concatenate(parts, axis=0)
+            data = csr.toarray()
         X = np.asarray(data, dtype=np.float64)
         if X.ndim == 1:
             X = X.reshape(1, -1)

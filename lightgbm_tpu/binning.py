@@ -362,16 +362,24 @@ def sample_for_binning(data: np.ndarray, sample_cnt: int, seed: int) -> Tuple[np
     """Row-sample the raw matrix and collect per-feature nonzero/NaN values
     (reference: dataset_loader.cpp:688-746 + :763 filter)."""
     num_data = data.shape[0]
+    sparse = hasattr(data, "tocsc")
     if num_data > sample_cnt:
         rng = np.random.default_rng(seed)
         idx = np.sort(rng.choice(num_data, size=sample_cnt, replace=False))
-        sample = data[idx]
+        sample = data.tocsr()[idx].tocsc() if sparse else data[idx]
     else:
         idx = np.arange(num_data)
-        sample = data
+        sample = data.tocsc() if sparse else data
     per_feature = []
     for j in range(sample.shape[1]):
-        col = np.asarray(sample[:, j], dtype=np.float64)
+        if sparse:
+            # stored entries only — implicit zeros are exactly what the
+            # nonzero/NaN filter below drops for dense input (indptr slicing
+            # works for csc_matrix and csc_array alike)
+            lo, hi = sample.indptr[j], sample.indptr[j + 1]
+            col = np.asarray(sample.data[lo:hi], dtype=np.float64)
+        else:
+            col = np.asarray(sample[:, j], dtype=np.float64)
         keep = (np.abs(col) > K_EPSILON) | np.isnan(col)
         per_feature.append(col[keep])
     return idx, per_feature

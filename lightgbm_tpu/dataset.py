@@ -157,8 +157,17 @@ class ConstructedDataset:
     def bin_raw(self, data: np.ndarray) -> np.ndarray:
         """Bin a raw feature matrix with THIS dataset's mappers (the analog of
         LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:221)."""
-        data = np.asarray(data)
         out = np.zeros((data.shape[0], self.num_features), dtype=self.X_binned.dtype)
+        if hasattr(data, "tocsc"):
+            csc = data.tocsc()
+            for inner, real in enumerate(self.real_feature_idx):
+                m = self.mappers[inner]
+                rows, vals = _csc_column(csc, real)
+                out[:, inner] = out.dtype.type(m.value_to_bin(np.zeros(1))[0])
+                if len(rows):
+                    out[rows, inner] = m.value_to_bin(vals)
+            return out
+        data = np.asarray(data)
         for inner, real in enumerate(self.real_feature_idx):
             out[:, inner] = self.mappers[inner].value_to_bin(data[:, real])
         return out
@@ -202,6 +211,14 @@ class ConstructedDataset:
         return ds
 
 
+def _csc_column(csc, j: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(row_indices, float64_values) of column ``j`` via indptr slicing —
+    works for both scipy.sparse csc_matrix and the newer csc_array (which
+    has no ``getcol``)."""
+    lo, hi = csc.indptr[j], csc.indptr[j + 1]
+    return csc.indices[lo:hi], np.asarray(csc.data[lo:hi], dtype=np.float64)
+
+
 def _parse_column_spec(spec: str, feature_names: List[str]) -> List[int]:
     """Parse 'name:a,name:b' or '0,1,2' column specs
     (reference: dataset_loader.cpp column resolution)."""
@@ -238,7 +255,11 @@ def construct_dataset(
     (dataset_loader.cpp:748-903): sample -> FindBin per feature -> drop
     trivial features -> materialize bin codes.
     """
-    data = np.ascontiguousarray(data)
+    sparse = hasattr(data, "tocsc")
+    if sparse:
+        data = data.tocsc()            # columnwise access for binning
+    else:
+        data = np.ascontiguousarray(data)
     if data.ndim != 2:
         Log.fatal("Training data must be 2-dimensional")
     num_data, num_total_features = data.shape
@@ -277,7 +298,17 @@ def construct_dataset(
     dtype = np.uint8 if all(f.mapper.num_bin <= 256 for f in features) else np.uint16
     X_binned = np.zeros((num_data, max(len(features), 1)), dtype=dtype)
     for inner, f in enumerate(features):
-        X_binned[:, inner] = f.mapper.value_to_bin(data[:, f.real_index]).astype(dtype)
+        if sparse:
+            # bin the implicit zeros once, scatter only the stored values
+            # (the float matrix is never densified; the dense uint8 bin
+            # matrix IS the design's storage — dataset.py:6-14)
+            rows, vals = _csc_column(data, f.real_index)
+            zero_bin = f.mapper.value_to_bin(np.zeros(1))[0]
+            X_binned[:, inner] = dtype(zero_bin)
+            if len(rows):
+                X_binned[rows, inner] = f.mapper.value_to_bin(vals).astype(dtype)
+        else:
+            X_binned[:, inner] = f.mapper.value_to_bin(data[:, f.real_index]).astype(dtype)
 
     metadata = Metadata(num_data)
     if label is not None:

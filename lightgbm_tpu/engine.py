@@ -185,6 +185,27 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     label = train_set.get_label()
     rng = np.random.default_rng(seed)
 
+    group_sizes = None if train_set.group is None else np.asarray(train_set.group,
+                                                                  dtype=np.int64)
+    if folds is None and group_sizes is not None:
+        # ranking: fold at QUERY granularity so group structure survives
+        # (reference engine.py:310 _make_n_folds uses GroupKFold when the
+        # dataset carries query boundaries)
+        nq = len(group_sizes)
+        q_order = np.arange(nq)
+        if shuffle:
+            rng.shuffle(q_order)
+        bounds = np.concatenate([[0], np.cumsum(group_sizes)])
+        q_chunks = np.array_split(q_order, nfold)
+
+        def rows_of(queries):
+            qs = np.sort(queries)
+            return np.concatenate([np.arange(bounds[q], bounds[q + 1])
+                                   for q in qs]) if len(qs) else np.array([], int)
+
+        folds = [(rows_of(np.concatenate([c for j, c in enumerate(q_chunks)
+                                          if j != f])),
+                  rows_of(q_chunks[f])) for f in range(nfold)]
     if folds is None:
         idx = np.arange(n)
         if stratified and label is not None and len(np.unique(label)) <= max(32, int(params.get("num_class", 2))):
@@ -206,11 +227,14 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
 
     results: Dict[str, List[float]] = collections.defaultdict(list)
     fold_records = []
+    qid = None if group_sizes is None else np.repeat(
+        np.arange(len(group_sizes)), group_sizes)
     for tr_idx, te_idx in folds:
         tr = train_set.subset(tr_idx, params=dict(train_set.params))
         te_raw = train_set.raw_data[te_idx]
         te_label = None if label is None else label[te_idx]
-        te = Dataset(te_raw, label=te_label, reference=tr)
+        te_group = None if qid is None else group_sizes[np.unique(qid[te_idx])]
+        te = Dataset(te_raw, label=te_label, group=te_group, reference=tr)
         evals_result: Dict = {}
         train(params, tr, num_boost_round=num_boost_round, valid_sets=[te],
               valid_names=["valid"], fobj=fobj, feval=feval,

@@ -113,6 +113,11 @@ def model_to_string(booster, num_iteration: Optional[int] = None) -> str:
     ss.append("feature importances:")
     for cnt, nm in pairs:
         ss.append(f"{nm}={cnt}")
+    if getattr(booster, "pandas_categorical", None) is not None:
+        # trailing JSON line, the reference python package's convention for
+        # persisting pandas category mappings (basic.py:226-268 save path)
+        import json
+        ss.append("pandas_categorical:" + json.dumps(booster.pandas_categorical))
     ss.append("")
     return "\n".join(ss)
 
@@ -217,6 +222,15 @@ def load_model_string(booster, model_str: str) -> None:
     from ..config import Config
     booster.config = Config.from_params(params)
     booster.params = params
+    for line in reversed(lines[-5:]):        # trailing JSON convention
+        if line.startswith("pandas_categorical:"):
+            import json
+            try:
+                booster.pandas_categorical = json.loads(
+                    line[len("pandas_categorical:"):])
+            except ValueError:
+                pass
+            break
 
 
 def load_model_file(booster, filename: str) -> None:

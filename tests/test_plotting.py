@@ -1,0 +1,66 @@
+"""Plotting tests (reference: tests/python_package_test/test_plotting.py)."""
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(0)
+    X = rng.rand(300, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.7).astype(float)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+              "min_data_in_leaf": 5, "metric": "binary_logloss"}
+    ds = lgb.Dataset(X, label=y)
+    record = {}
+    bst = lgb.train(params, ds, num_boost_round=10, valid_sets=[ds],
+                    valid_names=["train"], verbose_eval=False,
+                    callbacks=[lgb.record_evaluation(record)])
+    return bst, record
+
+
+def test_plot_importance(trained):
+    bst, _ = trained
+    ax = lgb.plot_importance(bst)
+    assert ax.get_title() == "Feature importance"
+    assert ax.get_xlabel() == "Feature importance"
+    assert len(ax.patches) >= 1
+    ax2 = lgb.plot_importance(bst, max_num_features=1, title="t",
+                              xlabel="x", ylabel="y")
+    assert len(ax2.patches) == 1
+    assert ax2.get_title() == "t"
+
+
+def test_plot_metric(trained):
+    _, record = trained
+    ax = lgb.plot_metric(record)
+    assert ax.get_ylabel() == "binary_logloss"
+    assert len(ax.get_lines()) == 1
+    assert len(ax.get_lines()[0].get_xdata()) == 10
+    with pytest.raises(ValueError):
+        lgb.plot_metric(record, metric="not_recorded")
+    with pytest.raises(TypeError):
+        lgb.plot_metric(lgb.Dataset(np.zeros((2, 2))))
+
+
+def test_plot_tree(trained):
+    bst, _ = trained
+    ax = lgb.plot_tree(bst, tree_index=1,
+                       show_info=["split_gain", "internal_count", "leaf_count"])
+    assert len(ax.texts) > 3
+    with pytest.raises(IndexError):
+        lgb.plot_tree(bst, tree_index=99)
+
+
+def test_create_tree_digraph(trained):
+    graphviz = pytest.importorskip("graphviz")
+    bst, _ = trained
+    g = lgb.create_tree_digraph(bst, tree_index=0,
+                                show_info=["split_gain", "leaf_count"])
+    assert isinstance(g, graphviz.Digraph)
+    src = g.source
+    assert "leaf" in src and "->" in src

@@ -43,6 +43,32 @@ def test_lgbm_ranker_sklearn():
     assert np.isfinite(pred).all()
 
 
+def test_lambdarank_cv_query_folds():
+    """cv() folds grouped data at query granularity (reference engine.py:310
+    _make_n_folds group handling)."""
+    X, y, g, *_ = _load_rank_data()
+    g = g.astype(int)
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "ndcg_eval_at": [3], "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 20}
+    ds = lgb.Dataset(X, label=y, group=g)
+    res = lgb.cv(params, ds, num_boost_round=5, nfold=3, seed=7)
+    assert "ndcg@3-mean" in res and len(res["ndcg@3-mean"]) == 5
+    assert all(0.0 < v <= 1.0 for v in res["ndcg@3-mean"])
+
+
+def test_grouped_subset_whole_queries():
+    X, y, g, *_ = _load_rank_data()
+    g = g.astype(int)
+    ds = lgb.Dataset(X, label=y, group=g)
+    # take the first two queries
+    rows = np.arange(g[0] + g[1])
+    sub = ds.subset(rows)
+    assert list(sub.group) == [g[0], g[1]]
+    with pytest.raises(lgb.LightGBMError):
+        ds.subset(np.arange(g[0] + 1))     # partial query -> fatal
+
+
 def test_ndcg_metric_math():
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.dataset import Metadata

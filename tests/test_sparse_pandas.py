@@ -1,0 +1,101 @@
+"""Sparse CSR/CSC input and pandas categorical handling
+(reference: c_api.cpp:471+ LGBM_DatasetCreateFromCSR/CSC;
+python-package/lightgbm/basic.py:226-268 pandas categorical;
+test_engine.py:481 pandas-categorical round-trip)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+scipy_sparse = pytest.importorskip("scipy.sparse")
+
+
+def _sparse_problem(n=600, f=12, density=0.15, seed=3):
+    rng = np.random.RandomState(seed)
+    X = scipy_sparse.random(n, f, density=density, random_state=rng,
+                            format="csr", dtype=np.float64)
+    dense = X.toarray()
+    y = (dense[:, 0] + dense[:, 1] * 2 > 0.12).astype(float)
+    return X, dense, y
+
+
+PARAMS = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+          "min_data_in_leaf": 5, "max_bin": 63}
+
+
+def test_csr_train_matches_dense():
+    X, dense, y = _sparse_problem()
+    b_sp = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=8)
+    b_de = lgb.train(PARAMS, lgb.Dataset(dense, label=y), num_boost_round=8)
+    p_sp = b_sp.predict(dense)
+    p_de = b_de.predict(dense)
+    np.testing.assert_allclose(p_sp, p_de, atol=1e-6)
+
+
+def test_csc_input_and_sparse_predict():
+    X, dense, y = _sparse_problem()
+    bst = lgb.train(PARAMS, lgb.Dataset(X.tocsc(), label=y), num_boost_round=8)
+    p_sparse = bst.predict(X)                       # CSR predict
+    p_dense = bst.predict(dense)
+    np.testing.assert_allclose(p_sparse, p_dense, atol=1e-12)
+
+
+def test_sparse_valid_set_reference():
+    X, dense, y = _sparse_problem()
+    tr = lgb.Dataset(X[:400], label=y[:400])
+    va = lgb.Dataset(X[400:], label=y[400:], reference=tr)
+    res = {}
+    lgb.train({**PARAMS, "metric": "binary_logloss"}, tr, num_boost_round=5,
+              valid_sets=[va], evals_result=res, verbose_eval=False)
+    assert len(res["valid_0"]["binary_logloss"]) == 5
+
+
+def test_pandas_categorical_roundtrip(tmp_path):
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(0)
+    n = 400
+    cats = ["low", "mid", "high", "ultra"]
+    df = pd.DataFrame({
+        "num": rng.rand(n),
+        "cat": pd.Categorical(rng.choice(cats, n), categories=cats),
+    })
+    y = ((df["cat"].cat.codes >= 2) ^ (df["num"] > 0.7)).astype(float)
+    ds = lgb.Dataset(df, label=y)
+    bst = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 7,
+                     "min_data_in_leaf": 5}, ds, num_boost_round=10)
+    assert bst.pandas_categorical == [cats]
+    p0 = bst.predict(df)
+    # shuffled category order in the predict frame must not change results
+    df2 = df.copy()
+    df2["cat"] = pd.Categorical(df["cat"].astype(str),
+                                categories=list(reversed(cats)))
+    p1 = bst.predict(df2)
+    np.testing.assert_allclose(p0, p1, atol=1e-12)
+    # model file round-trip keeps the category mapping
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    assert bst2.pandas_categorical == [cats]
+    np.testing.assert_allclose(bst2.predict(df2), p0, atol=1e-12)
+    # model learned the categorical feature at all
+    auc_proxy = np.mean((p0 > 0.5) == y.values.astype(bool))
+    assert auc_proxy > 0.8
+
+
+def test_pandas_unseen_category_is_missing():
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(1)
+    df = pd.DataFrame({
+        "num": rng.rand(200),
+        "cat": pd.Categorical(rng.choice(["a", "b"], 200)),
+    })
+    y = (df["num"] > 0.5).astype(float)
+    bst = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 4,
+                     "min_data_in_leaf": 5}, lgb.Dataset(df, label=y),
+                    num_boost_round=3)
+    df_new = pd.DataFrame({
+        "num": [0.2, 0.9],
+        "cat": pd.Categorical(["c", "a"], categories=["a", "b", "c"]),
+    })
+    p = bst.predict(df_new)              # unseen 'c' -> missing, no crash
+    assert np.isfinite(p).all()
