@@ -44,9 +44,6 @@ def _data_from_pandas(df, pandas_categorical=None):
 
 
 def _to_2d_float(data):
-    if hasattr(data, "values") and hasattr(data, "columns"):  # pandas DataFrame
-        arr, names, _, _ = _data_from_pandas(data)
-        return arr, names
     if _is_sparse(data):
         # keep sparse: binning densifies to uint8 bin codes columnwise
         # without ever materializing the float matrix (reference accepts
@@ -100,7 +97,12 @@ class Dataset:
                     feature_name = side["feature_names"]
         self.pandas_categorical = None
         if hasattr(data, "values") and hasattr(data, "columns"):   # DataFrame
-            arr, names, cat_cols, self.pandas_categorical = _data_from_pandas(data)
+            # a valid set aligned to a training set must encode categories
+            # with the TRAINING set's category lists, not its own frame's
+            # (codes are order-dependent; reference basic.py:226-268)
+            ref_pc = getattr(reference, "pandas_categorical", None)
+            arr, names, cat_cols, self.pandas_categorical = _data_from_pandas(
+                data, ref_pc)
             self.raw_data, inferred_names = arr, names
             if categorical_feature == "auto" and cat_cols:
                 categorical_feature = cat_cols

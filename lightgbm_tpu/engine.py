@@ -192,6 +192,9 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
         # (reference engine.py:310 _make_n_folds uses GroupKFold when the
         # dataset carries query boundaries)
         nq = len(group_sizes)
+        if nfold > nq:
+            raise ValueError(f"Cannot have number of folds={nfold} greater "
+                             f"than the number of queries={nq}")
         q_order = np.arange(nq)
         if shuffle:
             rng.shuffle(q_order)

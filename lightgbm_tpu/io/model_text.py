@@ -115,9 +115,16 @@ def model_to_string(booster, num_iteration: Optional[int] = None) -> str:
         ss.append(f"{nm}={cnt}")
     if getattr(booster, "pandas_categorical", None) is not None:
         # trailing JSON line, the reference python package's convention for
-        # persisting pandas category mappings (basic.py:226-268 save path)
+        # persisting pandas category mappings (basic.py:226-268 save path);
+        # default= handles numpy scalars / Timestamps like the reference's
+        # json_default_with_numpy
         import json
-        ss.append("pandas_categorical:" + json.dumps(booster.pandas_categorical))
+
+        def _json_default(o):
+            return o.item() if hasattr(o, "item") else str(o)
+
+        ss.append("pandas_categorical:"
+                  + json.dumps(booster.pandas_categorical, default=_json_default))
     ss.append("")
     return "\n".join(ss)
 
