@@ -30,16 +30,20 @@ class DART(GBDT):
         self.tree_weight: List[float] = []
         self.sum_weight = 0.0
         self._drop_rng = np.random.default_rng(config.drop_seed)
-        # train matrix may be EFB-bundled; valid matrices never are
+        # train matrix may be EFB-bundled; valid matrices never are.
+        # Feature metadata travels as jit arguments (multi-host forbids
+        # closing over arrays spanning non-addressable devices).
         self._contrib_fn = jax.jit(
-            lambda tree, Xb: self._tree_contrib(tree, Xb, self.bundle))
+            lambda tree, Xb, nb, mc, db: self._tree_contrib(
+                tree, Xb, nb, mc, db, self.bundle))
         self._contrib_fn_valid = jax.jit(
-            lambda tree, Xb: self._tree_contrib(tree, Xb, None))
+            lambda tree, Xb, nb, mc, db: self._tree_contrib(
+                tree, Xb, nb, mc, db, None))
 
-    def _tree_contrib(self, tree, Xb, bundle):
-        leaves = leaves_from_binned(tree, Xb, self.num_bins,
-                                    self.missing_code, self.default_bin,
-                                    bundle=bundle)
+    def _tree_contrib(self, tree, Xb, num_bins, missing_code, default_bin,
+                      bundle):
+        leaves = leaves_from_binned(tree, Xb, num_bins, missing_code,
+                                    default_bin, bundle=bundle)
         return tree.leaf_value[leaves]
 
     def _select_drop(self) -> List[int]:
@@ -81,13 +85,15 @@ class DART(GBDT):
         if k:
             drop_train = jnp.zeros_like(self.score)
             drop_valid = [jnp.zeros_like(vs.score) for vs in self.valid_sets]
+            nb, mc, db = self.num_bins, self.missing_code, self.default_bin
             for i in drop:
                 for c in range(K):
                     tree = self.models[i][c]
-                    drop_train = drop_train.at[c].add(self._contrib_fn(tree, self.Xb))
+                    drop_train = drop_train.at[c].add(
+                        self._contrib_fn(tree, self.Xb, nb, mc, db))
                     for vi, vs in enumerate(self.valid_sets):
                         drop_valid[vi] = drop_valid[vi].at[c].add(
-                            self._contrib_fn_valid(tree, vs.Xb))
+                            self._contrib_fn_valid(tree, vs.Xb, nb, mc, db))
             score_adj = self.score - drop_train
             for vi, vs in enumerate(self.valid_sets):
                 vs.score = vs.score - drop_valid[vi]

@@ -77,6 +77,11 @@ class GBDT:
                  objective: Optional[Objective] = None):
         self.config = config
         self.train_set = train_set
+        # multi-host wiring FIRST — jax.distributed.initialize must run
+        # before anything touches the XLA backend (mirrors the reference's
+        # Network::Init-before-LoadData ordering, application.cpp:167-178)
+        from ..parallel.comm import init_distributed
+        init_distributed(config)
         self.objective = objective if objective is not None else create_objective(config)
         if self.objective is not None:
             self.objective.init(train_set.metadata, train_set.num_data)
@@ -237,14 +242,23 @@ class GBDT:
         (every machine holds all data, feature_parallel_tree_learner.cpp).
         """
         pctx = self.pctx
-        x = jnp.asarray(x)
         if pctx.mesh is None:
-            return jax.device_put(x, pctx.devices[0])
+            return jax.device_put(jnp.asarray(x), pctx.devices[0])
         if kind == "repl" or pctx.strategy == "feature":
-            return jax.device_put(x, NamedSharding(pctx.mesh, P()))
-        spec = {"rows": P(pctx.ROW_AXIS), "rows0": P(pctx.ROW_AXIS, None),
-                "rows1": P(None, pctx.ROW_AXIS)}[kind]
-        return jax.device_put(x, NamedSharding(pctx.mesh, spec))
+            spec = P()
+        else:
+            spec = {"rows": P(pctx.ROW_AXIS), "rows0": P(pctx.ROW_AXIS, None),
+                    "rows1": P(None, pctx.ROW_AXIS)}[kind]
+        sharding = NamedSharding(pctx.mesh, spec)
+        if pctx.multi_process:
+            # every process holds the full (host) array; materialize only the
+            # locally-addressable shards of the global sharded array — the
+            # multi-host analog of the reference's non-pre-partitioned load
+            # (dataset_loader.cpp:159 rank/num_machines row partitioning)
+            x = np.asarray(x)
+            return jax.make_array_from_callback(x.shape, sharding,
+                                                lambda idx: x[idx])
+        return jax.device_put(jnp.asarray(x), sharding)
 
     def add_valid(self, name: str, binned: np.ndarray, metadata: Metadata) -> None:
         nv = binned.shape[0]
@@ -292,12 +306,23 @@ class GBDT:
         """Hook: base adds; RF maintains a running average (rf.hpp:117-121)."""
         return old_score_k + contrib
 
+    # device-array attributes captured by the training step; under
+    # multi-host they must travel as jit ARGUMENTS (closing over arrays
+    # spanning non-addressable devices is rejected), so the step rebinds
+    # them onto self for the duration of the trace.
+    _STEP_CONSTS = ("Xb", "label", "weight", "pad_mask", "feature_ok_base",
+                    "is_cat", "num_bins", "missing_code", "default_bin")
+
+    def _step_consts(self):
+        return ({a: getattr(self, a) for a in self._STEP_CONSTS},
+                tuple(vs.Xb for vs in self.valid_sets))
+
     def _make_step(self, custom_grads: bool = False):
         spec = self.spec
         K = self.num_models
         comm = self.comm
 
-        bundle = self.bundle
+        bundle = self.bundle              # EFB is serial-only: never sharded
 
         def grow_fn(X, g, h, inc, fok, iscat, nb, mc, db):
             return grow_tree(X, g, h, inc, fok, iscat, nb, mc, db, spec, comm,
@@ -305,7 +330,28 @@ class GBDT:
 
         grow = self.pctx.shard_grow(grow_fn)
 
-        def step(score, valid_scores, bag_mask, key, it, shrinkage, *grads):
+        def step(consts, valid_Xb, score, valid_scores, bag_mask, key, it,
+                 shrinkage, *grads):
+            # Rebind the captured arrays to this trace's tracers so every
+            # hook (_gradients/_sampling/RF/GOSS overrides) reads arguments,
+            # not baked-in constants. Python-level state is restored after
+            # tracing; compiled executions never run this body again.
+            saved = {a: getattr(self, a) for a in self._STEP_CONSTS}
+            saved_vXb = [vs.Xb for vs in self.valid_sets]
+            for a in self._STEP_CONSTS:
+                setattr(self, a, consts[a])
+            for vs, xb in zip(self.valid_sets, valid_Xb):
+                vs.Xb = xb
+            try:
+                return step_body(score, valid_scores, bag_mask, key, it,
+                                 shrinkage, *grads)
+            finally:
+                for a, v in saved.items():
+                    setattr(self, a, v)
+                for vs, xb in zip(self.valid_sets, saved_vXb):
+                    vs.Xb = xb
+
+        def step_body(score, valid_scores, bag_mask, key, it, shrinkage, *grads):
             if custom_grads:
                 g, h = grads
             else:
@@ -364,8 +410,9 @@ class GBDT:
         key = jax.random.fold_in(self._rng_key, self.iter_)
         valid_scores = tuple(tuple(vs.score[k] for k in range(self.num_models))
                              for vs in self.valid_sets)
+        consts, valid_Xb = self._step_consts()
         score, out_valid, self.bag_mask, trees, nl = fn(
-            score, valid_scores, self.bag_mask, key,
+            consts, valid_Xb, score, valid_scores, self.bag_mask, key,
             jnp.asarray(self.iter_, jnp.int32),
             jnp.asarray(shrinkage, jnp.float32), *extra)
         self.models.append(list(trees))
@@ -386,7 +433,7 @@ class GBDT:
         LGBM_BoosterUpdateOneIterCustom, c_api.cpp:892): fobj(preds, dataset)
         -> (grad, hess) as numpy [K*N] in class-major order."""
         K, Npad, N = self.num_models, self.num_data_padded, self.num_data
-        preds = np.asarray(self.score)[:, :N].reshape(-1)
+        preds = self._fetch(self.score)[:, :N].reshape(-1)
         grad, hess = fobj(preds, self.train_set)
         g = np.zeros((K, Npad), np.float32)
         h = np.zeros((K, Npad), np.float32)
@@ -394,7 +441,7 @@ class GBDT:
         h[:, :N] = np.asarray(hess, np.float32).reshape(K, N)
         score, out_valid = self._run_step(
             self.score, self.config.learning_rate,
-            custom_gh=(jnp.asarray(g), jnp.asarray(h)))
+            custom_gh=(self._put(g, "rows1"), self._put(h, "rows1")))
         self.score = score
         for vi, vs in enumerate(self.valid_sets):
             vs.score = jnp.stack(out_valid[vi])
@@ -500,15 +547,25 @@ class GBDT:
 
     # ------------------------------------------------------------------- eval
 
+    def _fetch(self, arr) -> np.ndarray:
+        """Device->host fetch that works for row-sharded arrays under
+        multi-host execution (reassembles the global value on every process
+        — the analog of the reference's metric eval running on each rank's
+        local rows + allreduce; here metrics are computed on the full vector)."""
+        if self.pctx.multi_process and not arr.is_fully_replicated:
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+        return np.asarray(arr)
+
     def eval_all(self) -> List[Tuple[str, str, float, bool]]:
         out = []
         if self.config.is_training_metric and self.train_metrics:
-            conv = np.asarray(self._convert(self.score))[:, : self.num_data]
+            conv = self._fetch(self._convert(self.score))[:, : self.num_data]
             for m in self.train_metrics:
                 for name, value, hib in m.eval(conv):
                     out.append(("training", name, value, hib))
         for vs in self.valid_sets:
-            conv = np.asarray(self._convert(vs.score))
+            conv = self._fetch(self._convert(vs.score))
             for m in vs.metrics:
                 for name, value, hib in m.eval(conv):
                     out.append((vs.name, name, value, hib))

@@ -308,6 +308,11 @@ class ParallelContext:
         else:
             self.mesh = Mesh(np.array(self.devices), (self.ROW_AXIS,))
 
+    @property
+    def multi_process(self) -> bool:
+        """True under jax.distributed multi-host execution."""
+        return self.mesh is not None and jax.process_count() > 1
+
     # -------------------------------------------------------------- shapes
 
     def pad_features_to(self, F: int) -> int:
@@ -355,6 +360,79 @@ class ParallelContext:
                              out_specs=out_specs, check_vma=False)
 
 
+def parse_machine_list(config) -> list:
+    """Machine list as ``[(host, port), ...]`` from ``machines`` (comma- or
+    newline-separated ``host:port`` / ``host port``) or ``machine_list_file``
+    (reference: NetworkConfig, config.h:264-272; file format of
+    examples/parallel_learning/mlist.txt)."""
+    text = config.machines or ""
+    if not text and config.machine_list_file:
+        with open(config.machine_list_file) as fh:
+            text = fh.read()
+    out = []
+    for chunk in text.replace(",", "\n").splitlines():
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        host, port = chunk.split(":") if ":" in chunk else chunk.split()
+        out.append((host.strip(), int(port)))
+    return out
+
+
+def _local_rank(machines, local_listen_port: int) -> int:
+    """This process's rank: the machine-list entry whose host is a local
+    address AND whose port matches local_listen_port (the reference's rank
+    discovery, linkers_socket.cpp:20-47, disambiguated by listen port so
+    multiple ranks can share a host)."""
+    import socket
+    local_names = {"localhost", "127.0.0.1", socket.gethostname()}
+    try:
+        local_names.update(socket.gethostbyname_ex(socket.gethostname())[2])
+    except OSError:
+        pass
+    matches = [i for i, (h, p) in enumerate(machines)
+               if p == local_listen_port and (h in local_names)]
+    if len(matches) == 1:
+        return matches[0]
+    # fall back: unique local host regardless of port
+    host_matches = [i for i, (h, _) in enumerate(machines) if h in local_names]
+    if len(host_matches) == 1:
+        return host_matches[0]
+    raise RuntimeError(
+        f"cannot determine machine rank: {len(matches)} machine-list entries "
+        f"match local addresses {sorted(local_names)} with port "
+        f"{local_listen_port}")
+
+
+def init_distributed(config) -> bool:
+    """Wire multi-host execution when the reference's network params are set
+    (reference: Network::Init + rank discovery, application.cpp:167-178,
+    linkers_socket.cpp:20-47 — here the transport is jax.distributed's
+    coordination service + XLA collectives over ICI/DCN instead of a TCP
+    mesh). Returns True if running multi-process after the call."""
+    import jax
+    from jax._src import distributed as _dist
+    if _dist.global_state.client is not None:
+        return jax.process_count() > 1        # already initialized
+    if getattr(config, "num_machines", 1) <= 1:
+        return False
+    machines = parse_machine_list(config)
+    if len(machines) <= 1:
+        return False
+    if len(machines) != config.num_machines:
+        from ..utils.log import Log
+        Log.warning("num_machines=%d but machine list has %d entries; "
+                    "using the list", config.num_machines, len(machines))
+    rank = _local_rank(machines, config.local_listen_port)
+    coord = f"{machines[0][0]}:{machines[0][1]}"
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=len(machines),
+                               process_id=rank,
+                               # reference time_out is MINUTES (config.h:272)
+                               initialization_timeout=config.time_out * 60)
+    return jax.process_count() > 1
+
+
 def select_devices(config):
     """Devices for this booster, honoring the reference's ``device`` param:
     ``tpu`` (default) uses the accelerator backend; ``cpu`` forces the host
@@ -371,12 +449,25 @@ def select_devices(config):
 
 def make_parallel_context(config, devices=None) -> ParallelContext:
     """Build the context from config (reference: Network::Init,
-    application.cpp:167-178 — here the 'network' is just the device mesh)."""
+    application.cpp:167-178 — here the 'network' is the device mesh, and a
+    machine list triggers jax.distributed multi-host wiring)."""
     strategy = getattr(config, "tree_learner", "serial")
     if devices is None:
+        multi = init_distributed(config)
         devices = select_devices(config)
         nm = getattr(config, "num_machines", 1)
-        if nm and nm > 1:
+        if multi:
+            # global mesh over every host's chips; serial would device_put to
+            # another process's chip — pick the reference's distributed
+            # default (data parallel) instead
+            if strategy == "serial":
+                from ..utils.log import Log
+                Log.warning("tree_learner=serial is not distributed; using "
+                            "tree_learner=data across %d processes",
+                            jax.process_count())
+                strategy = "data"
+        elif nm and nm > 1:
+            # single-process fallback: emulate machines with local devices
             devices = devices[: min(nm, len(devices))]
         elif strategy == "serial":
             devices = devices[:1]

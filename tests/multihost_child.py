@@ -1,0 +1,33 @@
+"""Child process for the multi-host test: trains data-parallel over a
+2-process jax.distributed CPU cluster wired through the reference's network
+params (machines + local_listen_port + num_machines) and writes the model
+from rank 0.
+
+Usage: python multihost_child.py <rank> <port0> <port1> <out_model>
+"""
+import sys
+
+rank, port0, port1, out_model = (int(sys.argv[1]), int(sys.argv[2]),
+                                 int(sys.argv[3]), sys.argv[4])
+
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(7)
+X = rng.rand(4000, 10)
+y = X[:, 0] * 3 + X[:, 1] ** 2 + 0.1 * rng.randn(4000)
+
+params = {
+    "objective": "regression", "verbose": -1, "num_leaves": 15,
+    "min_data_in_leaf": 20, "max_bin": 63, "tree_learner": "data",
+    "device": "cpu", "num_machines": 2,
+    "machines": f"127.0.0.1:{port0},127.0.0.1:{port1}",
+    "local_listen_port": port0 if rank == 0 else port1,
+}
+bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+
+import jax
+assert jax.process_count() == 2, jax.process_count()
+if jax.process_index() == 0:
+    bst.save_model(out_model)
+print(f"rank {rank} done", flush=True)
