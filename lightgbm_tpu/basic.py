@@ -480,14 +480,47 @@ class Booster:
                         margin = part[-1] - part[-2]
                     active[rows] = margin < margin_thr
         else:
-            for i, t in enumerate(use_trees):
-                raw[i % K] += t.predict(X)
+            # large batches route through the device-side stacked-forest
+            # evaluator (integer rank-exact traversal; the analog of the
+            # reference's OMP row-parallel Predictor, predictor.hpp:25-241);
+            # categorical splits stay on the host path
+            device_ok = (N * max(len(use_trees), 1) >= 1_000_000
+                         and not kwargs.get("force_host_predict", False))
+            forests = None
+            if device_ok:
+                forests = self._stacked_forests(use_trees, K)
+                device_ok = forests is not None
+            if device_ok:
+                from .ops.predict import forest_predict_raw
+                for k in range(K):
+                    raw[k] = forest_predict_raw(
+                        use_trees[k::K], X, self.num_total_features,
+                        forest=forests[k])
+            else:
+                for i, t in enumerate(use_trees):
+                    raw[i % K] += t.predict(X)
         if self.config.boosting_normalized == "rf":
             # average of already-converted tree outputs (rf.hpp average_output_)
             raw /= max(len(use_trees) // K, 1)
         elif not raw_score:
             raw = self._convert_output(raw)
         return raw[0] if K == 1 else raw.T
+
+    def _stacked_forests(self, use_trees, K: int):
+        """Per-class StackedForests for device batch predict, cached across
+        calls (rebuilt when the forest grows). Returns None when any class
+        slice holds a categorical split — the host path handles those."""
+        from .ops.predict import StackedForest
+        key = (len(self.trees), len(use_trees), K)
+        cached = getattr(self, "_stacked_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        forests = [StackedForest(use_trees[k::K], self.num_total_features)
+                   for k in range(K)]
+        if any(f.has_categorical for f in forests):
+            forests = None
+        self._stacked_cache = (key, forests)
+        return forests
 
     def _convert_output(self, raw: np.ndarray) -> np.ndarray:
         obj = self.config.objective
