@@ -515,10 +515,11 @@ class Booster:
         cached = getattr(self, "_stacked_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
-        forests = [StackedForest(use_trees[k::K], self.num_total_features)
-                   for k in range(K)]
-        if any(f.has_categorical for f in forests):
-            forests = None
+        if any((np.asarray(t.decision_type) & 1).any() for t in use_trees):
+            forests = None                   # cheap pre-scan: host path
+        else:
+            forests = [StackedForest(use_trees[k::K], self.num_total_features)
+                       for k in range(K)]
         self._stacked_cache = (key, forests)
         return forests
 

@@ -179,6 +179,7 @@ class GBDT:
             min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
             min_gain_to_split=config.min_gain_to_split,
             row_compact=config.tpu_row_compact,
+            hist_kernel=config.tpu_hist_kernel,
             hist_bins=self._hist_bins,
             use_categorical=bool(meta["is_categorical"].any()),
             cat_smooth=config.cat_smooth,

@@ -286,6 +286,9 @@ class Config:
     # prefix-compacted index gather (the analog of the reference's
     # smaller-leaf histogramming, serial_tree_learner.cpp:354-362)
     tpu_row_compact: bool = True
+    # histogram kernel: "xla" one-hot matmul | "pallas" fused VMEM-accumulator
+    # kernel (ops/pallas_histogram.py, the OpenCL histogram256.cl analog)
+    tpu_hist_kernel: str = "xla"
 
     def __post_init__(self):
         self._check()
@@ -330,6 +333,8 @@ class Config:
             Log.fatal("Unknown boosting type %s", self.boosting_type)
         if self.tree_learner not in ("serial", "feature", "data", "voting"):
             Log.fatal("Unknown tree learner type %s", self.tree_learner)
+        if self.tpu_hist_kernel not in ("xla", "pallas"):
+            Log.fatal("Unknown tpu_hist_kernel %s (xla|pallas)", self.tpu_hist_kernel)
         if self.boosting_type in ("rf", "random_forest"):
             # reference: rf.hpp:18-29 — bagging is mandatory for random forest
             if not (self.bagging_freq > 0 and self.bagging_fraction < 1.0):

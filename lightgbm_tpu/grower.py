@@ -134,6 +134,8 @@ class GrowerSpec:
     row_compact: bool = True      # histogram only pending-leaf rows per wave
     hist_bins: int = 0            # bin axis of the histogram BUILD (EFB bundle
                                   # space); 0 = num_bins_padded (unbundled)
+    hist_kernel: str = "xla"      # "xla" (one-hot matmul) | "pallas" (fused
+                                  # VMEM-accumulator kernel, ops/pallas_histogram.py)
     # categorical split search (reference config.h:230-234)
     use_categorical: bool = False
     cat_smooth: float = 10.0
@@ -292,10 +294,18 @@ def grow_tree(
             row_idx, n_active = compact_rows(state.leaf_id, slot_of_leaf)
         else:
             row_idx = n_active = None
-        new_hist = build_histograms(
-            X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
-            num_slots=S, num_bins_padded=B_hist, chunk_rows=spec.chunk_rows,
-            row_idx=row_idx, n_active=n_active)
+        if spec.hist_kernel == "pallas":
+            from .ops.pallas_histogram import build_histograms_pallas
+            new_hist = build_histograms_pallas(
+                X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
+                num_slots=S, num_bins_padded=B_hist,
+                chunk_rows=spec.chunk_rows, row_idx=row_idx,
+                n_active=n_active)
+        else:
+            new_hist = build_histograms(
+                X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
+                num_slots=S, num_bins_padded=B_hist, chunk_rows=spec.chunk_rows,
+                row_idx=row_idx, n_active=n_active)
         new_hist = comm.reduce_hist(new_hist)
 
         # ---- 3. cache write + sibling by subtraction -----------------------

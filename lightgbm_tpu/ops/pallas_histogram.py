@@ -1,0 +1,191 @@
+"""Pallas TPU histogram kernel — the direct replacement for the reference's
+OpenCL histogram kernels (src/treelearner/ocl/histogram256.cl:95-125
+local-memory atomic sub-histograms).
+
+Design (vs the XLA one-hot matmul in ops/histogram.py):
+
+- The [S*ch, F*B] f32 accumulator lives in VMEM scratch for the whole pass
+  (≈2.3MB at S=16, ch=5, F=28, B=256) — the analog of the OpenCL kernel's
+  per-workgroup local-memory sub-histograms, but with NO atomics: one core
+  owns the whole accumulator and the grid walks row chunks sequentially.
+- Each grid step loads a row chunk's bin codes [R, F] (uint8 -> tiny DMA),
+  builds the per-leaf-slot weight columns rhs [R, S*ch] and the per-feature
+  one-hot [R, B] IN VMEM (never HBM), and feeds the MXU with
+  [S*ch, R] x [R, B] contractions per feature. The one-hot generation (VPU)
+  pipelines against the matmul (MXU).
+- Row compaction composes as a *chunk-level skip*: rows gathered to a
+  pending-prefix order by the caller, and chunks past ceil(n_active/R) skip
+  their compute via @pl.when — a skipped chunk costs only its (tiny) DMA,
+  so the pass needs no dynamic trip count and no scatter.
+
+Precision matches ops/histogram.py: bf16 hi+lo gradient/hessian channels
+accumulated in f32 (~f32-exact; the reference GPU path used plain f32 and
+accepted small deltas, docs/GPU-Performance.rst:131-133). Counts are exact
+(bf16 1.0 * onehot accumulated in f32).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Importing pallas' TPU backend registers MLIR lowerings for platform "tpu",
+# which jax rejects when only the CPU plugin is present (the interpret-mode
+# test bed). Registering the identity alias first makes "tpu" a known
+# platform without initializing any backend.
+from jax._src import xla_bridge as _xb
+if not _xb.is_known_platform("tpu"):
+    _xb._platform_aliases["tpu"] = "tpu"
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .histogram import NUM_CHANNELS, _split_hi_lo
+
+_INTERPRET = False   # flipped by tests on CPU
+
+
+def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
+                 x_ref,               # [R, F] int32 bin codes (chunk)
+                 slot_ref,            # [R, 1] i32 slot per row (-1 = masked)
+                 w_ref,               # [R, ch] bf16 weight channels (chunk)
+                 out_ref,             # [SC, F*B] f32
+                 acc_ref,             # VMEM scratch [SC, F*B] f32
+                 *, chunk_rows: int, num_bins: int, num_features: int,
+                 num_slots: int, f_block: int):
+    i = pl.program_id(0)
+    n_chunks = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # chunk-level skip: all rows of this chunk are past the active prefix
+    @pl.when(i * chunk_rows < n_active_ref[0])
+    def _compute():
+        x = x_ref[:]                                       # [R, F] i32
+        # slot-weight columns built IN VMEM (never round-tripped via HBM):
+        # rhs[r, s*ch+c] = (slot[r]==s) * w[r, c]
+        ch = w_ref.shape[1]
+        slot = slot_ref[:]                                 # [R, 1]
+        iota_s = jax.lax.broadcasted_iota(
+            jnp.int32, (chunk_rows, num_slots * ch), 1) // ch
+        rhs = ((slot == iota_s).astype(jnp.bfloat16)
+               * jnp.tile(w_ref[:], (1, num_slots)))       # [R, SC]
+
+        for f0 in range(0, num_features, f_block):
+            fb = min(f_block, num_features - f0)
+            # one-hot for fb features at once: [R, fb*B]
+            xs = x[:, f0:f0 + fb]                          # [R, fb]
+            xb = jnp.repeat(xs, num_bins, axis=1)          # [R, fb*B]
+            iota_b = jax.lax.broadcasted_iota(
+                jnp.int32, (chunk_rows, fb * num_bins), 1) % num_bins
+            onehot = (xb == iota_b).astype(jnp.bfloat16)
+            part = jax.lax.dot_general(
+                rhs, onehot,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [SC, fb*B]
+            sl = slice(f0 * num_bins, (f0 + fb) * num_bins)
+            acc_ref[:, sl] += part
+
+    @pl.when(i == n_chunks - 1)
+    def _flush():
+        out_ref[:] = acc_ref[:]
+
+
+def hist_pallas(
+    X: jnp.ndarray,            # [N, F] uint8/uint16 bin codes
+    slot: jnp.ndarray,         # [N] i32 histogram slot per row, -1 = skip
+    grad: jnp.ndarray,         # [N] f32
+    hess: jnp.ndarray,         # [N] f32
+    included: jnp.ndarray,     # [N] f32 0/1
+    num_slots: int,
+    num_bins: int,
+    chunk_rows: int = 2048,
+    n_active: Optional[jnp.ndarray] = None,   # i32: rows [0, n_active) matter
+    f_block: int = 4,
+) -> jnp.ndarray:
+    """Returns hist [S, F, B, 3] f32 (sum_g, sum_h, count).
+
+    The caller may pre-gather rows into a pending prefix and pass
+    ``n_active`` — chunks fully past it skip compute (cheap DMA only).
+    """
+    N, F = X.shape
+    ch = NUM_CHANNELS
+    SC = num_slots * ch
+    assert N % chunk_rows == 0, (N, chunk_rows)
+    if n_active is None:
+        n_active = jnp.asarray(N, jnp.int32)
+
+    # weight channels only ([N, ch] bf16) — the [N, S*ch] slot-expanded rhs
+    # is built per chunk inside the kernel, in VMEM
+    g_hi, g_lo = _split_hi_lo(grad)
+    h_hi, h_lo = _split_hi_lo(hess)
+    w = jnp.stack([g_hi, g_lo, h_hi, h_lo,
+                   included.astype(jnp.bfloat16)], axis=-1)       # [N, ch]
+
+    x_i32 = X.astype(jnp.int32)
+    n_chunks = N // chunk_rows
+
+    kernel = functools.partial(
+        _hist_kernel, chunk_rows=chunk_rows, num_bins=num_bins,
+        num_features=F, num_slots=num_slots, f_block=min(f_block, F))
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_chunks,),
+            in_specs=[
+                pl.BlockSpec((chunk_rows, F), lambda i, n: (i, 0)),
+                pl.BlockSpec((chunk_rows, 1), lambda i, n: (i, 0)),
+                pl.BlockSpec((chunk_rows, ch), lambda i, n: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((SC, F * num_bins), lambda i, n: (0, 0)),
+            scratch_shapes=[pltpu.VMEM((SC, F * num_bins), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((SC, F * num_bins), jnp.float32),
+        interpret=_INTERPRET,
+    )(n_active.reshape(1), x_i32, slot.reshape(N, 1), w)
+
+    acc = out.reshape(num_slots, ch, F, num_bins)
+    acc = jnp.transpose(acc, (0, 2, 3, 1))                        # [S, F, B, ch]
+    sum_g = acc[..., 0] + acc[..., 1]
+    sum_h = acc[..., 2] + acc[..., 3]
+    cnt = acc[..., 4]
+    return jnp.stack([sum_g, sum_h, cnt], axis=-1)                # [S, F, B, 3]
+
+
+def build_histograms_pallas(
+    X: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    included: jnp.ndarray,
+    leaf_id: jnp.ndarray,
+    slot_of_leaf: jnp.ndarray,
+    num_slots: int,
+    num_bins_padded: int,
+    chunk_rows: int,
+    row_idx: jnp.ndarray = None,
+    n_active: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """Drop-in replacement for ops.histogram.build_histograms backed by the
+    Pallas kernel (same signature/semantics — the GPU_DEBUG_COMPARE analog
+    lives in tests/test_pallas_hist.py)."""
+    if row_idx is not None:
+        # pending-prefix gather; garbage tail rows are masked via slot=-1
+        X = jnp.take(X, row_idx, axis=0)
+        grad = jnp.take(grad, row_idx)
+        hess = jnp.take(hess, row_idx)
+        included = jnp.take(included, row_idx)
+        leaf_id = jnp.take(leaf_id, row_idx)
+        pos = jnp.arange(X.shape[0], dtype=jnp.int32)
+        slot = jnp.where(pos < n_active, slot_of_leaf[leaf_id], -1)
+    else:
+        slot = slot_of_leaf[leaf_id]
+        n_active = None
+    return hist_pallas(X, slot, grad, hess, included, num_slots,
+                       num_bins_padded, chunk_rows=min(chunk_rows, X.shape[0]),
+                       n_active=n_active)
