@@ -1,0 +1,478 @@
+"""Python side of the C API (reference: src/c_api.cpp, 1,448 LoC).
+
+The native shim (capi/lgbm_capi.c) exposes the reference's ``LGBM_*``
+symbols and proxies every call here. The split keeps the C layer to
+argument forwarding: buffers cross the boundary as raw addresses
+(int64) + dtype codes, and this module views them with numpy/ctypes —
+zero-copy in, explicit memcpy out. Handles given to C are small integers
+into a registry (no PyObject lifetime crosses the boundary).
+
+Matches c_api.h semantics: C_API_DTYPE_* codes (c_api.h:22-25),
+C_API_PREDICT_* (c_api.h:27-30), 0/-1 return codes with
+LGBM_GetLastError() carrying the message.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+from typing import Dict, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import resolve_aliases
+
+# ---- handle registry -------------------------------------------------------
+
+_objects: Dict[int, object] = {}
+_next_handle = [1]
+
+
+def _register(obj) -> int:
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _objects[h] = obj
+    return h
+
+
+def _get(h: int):
+    return _objects[int(h)]
+
+
+def free_handle(h: int) -> None:
+    _objects.pop(int(h), None)
+
+
+# ---- raw-memory views ------------------------------------------------------
+
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+
+
+def _view(ptr: int, dtype_code: int, count: int) -> np.ndarray:
+    ct = {0: ctypes.c_float, 1: ctypes.c_double,
+          2: ctypes.c_int32, 3: ctypes.c_int64}[int(dtype_code)]
+    buf = (ct * int(count)).from_address(int(ptr))
+    return np.ctypeslib.as_array(buf)
+
+
+def _write_doubles(ptr: int, values: np.ndarray) -> int:
+    arr = np.ascontiguousarray(values, dtype=np.float64)
+    ctypes.memmove(int(ptr), arr.ctypes.data, arr.nbytes)
+    return arr.size
+
+
+def _write_string(ptr: int, text: str, buffer_len: int) -> int:
+    """Reference out_len contract (c_api.cpp SaveModelToString): report
+    len+1 (including NUL) and copy ONLY when the whole string fits, so the
+    two-call size-then-fetch protocol never truncates silently."""
+    raw = text.encode("utf-8") + b"\0"
+    if len(raw) <= int(buffer_len):
+        ctypes.memmove(int(ptr), raw, len(raw))
+    return len(raw)
+
+
+def _write_string_array(ptrs_addr: int, strings, each_len: int = 255) -> int:
+    """Fill a char** (preallocated buffers, reference basic.py convention)."""
+    arr = (ctypes.c_void_p * len(strings)).from_address(int(ptrs_addr))
+    for i, s in enumerate(strings):
+        raw = s.encode("utf-8")[: each_len - 1] + b"\0"
+        ctypes.memmove(arr[i], raw, len(raw))
+    return len(strings)
+
+
+def _params(parameters: Optional[str]) -> dict:
+    out = {}
+    for tok in (parameters or "").replace("\n", " ").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k] = v
+    return resolve_aliases(out)
+
+
+# ---- dataset ---------------------------------------------------------------
+
+def dataset_create_from_file(filename: str, parameters: str,
+                             reference: int) -> int:
+    params = _params(parameters)
+    ref = _get(reference) if reference else None
+    ds = Dataset(filename, params=params, reference=ref)
+    ds.construct()
+    return _register(ds)
+
+
+def dataset_create_from_mat(data_ptr: int, data_type: int, nrow: int,
+                            ncol: int, is_row_major: int, parameters: str,
+                            reference: int) -> int:
+    flat = _view(data_ptr, data_type, nrow * ncol)
+    mat = flat.reshape(nrow, ncol) if is_row_major else \
+        flat.reshape(ncol, nrow).T
+    params = _params(parameters)
+    ref = _get(reference) if reference else None
+    ds = Dataset(np.array(mat, dtype=np.float64), params=params, reference=ref)
+    return _register(ds)
+
+
+def dataset_create_from_csr(indptr_ptr: int, indptr_type: int,
+                            indices_ptr: int, data_ptr: int, data_type: int,
+                            nindptr: int, nelem: int, num_col: int,
+                            parameters: str, reference: int) -> int:
+    import scipy.sparse as sp
+    indptr = _view(indptr_ptr, indptr_type, nindptr).astype(np.int64)
+    indices = _view(indices_ptr, 2, nelem)
+    data = _view(data_ptr, data_type, nelem)
+    csr = sp.csr_matrix((np.array(data, np.float64), np.array(indices),
+                         np.array(indptr)), shape=(nindptr - 1, num_col))
+    ref = _get(reference) if reference else None
+    ds = Dataset(csr, params=_params(parameters), reference=ref)
+    return _register(ds)
+
+
+def dataset_create_from_csc(colptr_ptr: int, colptr_type: int,
+                            indices_ptr: int, data_ptr: int, data_type: int,
+                            ncolptr: int, nelem: int, num_row: int,
+                            parameters: str, reference: int) -> int:
+    import scipy.sparse as sp
+    colptr = _view(colptr_ptr, colptr_type, ncolptr).astype(np.int64)
+    indices = _view(indices_ptr, 2, nelem)
+    data = _view(data_ptr, data_type, nelem)
+    csc = sp.csc_matrix((np.array(data, np.float64), np.array(indices),
+                         np.array(colptr)), shape=(num_row, ncolptr - 1))
+    ds = Dataset(csc, params=_params(parameters),
+                 reference=_get(reference) if reference else None)
+    return _register(ds)
+
+
+def dataset_get_subset(handle: int, indices_ptr: int, num_indices: int,
+                       parameters: str) -> int:
+    ds: Dataset = _get(handle)
+    idx = np.array(_view(indices_ptr, 2, num_indices))
+    return _register(ds.subset(idx, params=_params(parameters)))
+
+
+def dataset_set_feature_names(handle: int, names) -> None:
+    _get(handle).feature_name = list(names)
+
+
+def dataset_get_feature_names(handle: int, ptrs_addr: int) -> int:
+    ds: Dataset = _get(handle)
+    names = ds.feature_name if isinstance(ds.feature_name, list) else \
+        [f"Column_{i}" for i in range(ds.num_feature())]
+    return _write_string_array(ptrs_addr, names)
+
+
+def dataset_save_binary(handle: int, filename: str) -> None:
+    ds: Dataset = _get(handle)
+    ds.construct()
+    ds._constructed.save_binary(filename)
+
+
+def dataset_set_field(handle: int, field: str, ptr: int, n: int,
+                      dtype_code: int) -> None:
+    ds: Dataset = _get(handle)
+    arr = np.array(_view(ptr, dtype_code, n))
+    if field == "label":
+        ds.set_label(arr.astype(np.float32))
+    elif field == "weight":
+        ds.set_weight(arr.astype(np.float32))
+    elif field in ("group", "query"):
+        ds.set_group(arr.astype(np.int32))
+    elif field == "init_score":
+        ds.set_init_score(arr.astype(np.float64))
+    else:
+        raise ValueError(f"unknown field {field}")
+
+
+def dataset_get_field(handle: int, field: str, out_ptr_addr: int,
+                      out_type_addr: int) -> int:
+    """Returns length; writes the array pointer + dtype code like
+    LGBM_DatasetGetField (c_api.cpp). The array is kept alive on the
+    dataset object."""
+    ds: Dataset = _get(handle)
+    val = ds.get_field(field)
+    if val is None:
+        return 0
+    if field in ("group", "query"):
+        arr = np.ascontiguousarray(val, dtype=np.int32)
+        code = 2
+    else:
+        arr = np.ascontiguousarray(val, dtype=np.float32)
+        code = 0
+    if not hasattr(ds, "_capi_field_refs"):
+        ds._capi_field_refs = {}
+    ds._capi_field_refs[field] = arr            # keep buffer alive
+    ctypes.c_void_p.from_address(int(out_ptr_addr)).value = arr.ctypes.data
+    ctypes.c_int32.from_address(int(out_type_addr)).value = code
+    return arr.size
+
+
+def dataset_get_num_data(handle: int) -> int:
+    return int(_get(handle).num_data())
+
+
+def dataset_get_num_feature(handle: int) -> int:
+    return int(_get(handle).num_feature())
+
+
+# ---- booster ---------------------------------------------------------------
+
+def booster_create(train_handle: int, parameters: str) -> int:
+    bst = Booster(params=_params(parameters), train_set=_get(train_handle))
+    return _register(bst)
+
+
+def booster_create_from_modelfile(filename: str) -> int:
+    return _register(Booster(model_file=filename))
+
+
+def booster_load_from_string(model_str: str) -> int:
+    return _register(Booster(model_str=model_str))
+
+
+def booster_add_valid_data(handle: int, valid_handle: int) -> None:
+    bst: Booster = _get(handle)
+    vs: Dataset = _get(valid_handle)
+    if vs.reference is None:
+        vs.reference = bst.train_dataset
+    bst.add_valid(vs, f"valid_{len(getattr(bst._gbdt, 'valid_sets', []))}")
+
+
+def booster_reset_training_data(handle: int, train_handle: int) -> None:
+    bst: Booster = _get(handle)
+    # update(train_set=...) swaps the data AND trains one iteration;
+    # rollback_one_iter fully reverts that extra iteration (trees + score),
+    # matching LGBM_BoosterResetTrainingData's swap-only contract
+    bst.update(train_set=_get(train_handle))
+    bst.rollback_one_iter()
+
+
+def booster_reset_parameter(handle: int, parameters: str) -> None:
+    _get(handle).reset_parameter(_params(parameters))
+
+
+def booster_get_num_classes(handle: int) -> int:
+    return max(int(_get(handle).params.get("num_class", 1)), 1)
+
+
+def booster_update_one_iter(handle: int) -> int:
+    bst: Booster = _get(handle)
+    before = bst._gbdt.iter_
+    bst.update()
+    return 1 if bst._gbdt.iter_ == before else 0   # is_finished
+
+
+def dataset_get_num_data_of_booster(handle: int) -> int:
+    """Gradient length for LGBM_BoosterUpdateOneIterCustom: num_data *
+    num_models (class-major, reference c_api.cpp UpdateOneIterCustom)."""
+    bst: Booster = _get(handle)
+    return int(bst.train_dataset.num_data()
+               * max(bst.num_model_per_iteration, 1))
+
+
+def booster_update_one_iter_custom(handle: int, grad_ptr: int, hess_ptr: int,
+                                   n: int) -> int:
+    bst: Booster = _get(handle)
+    g = np.array(_view(grad_ptr, 0, n), np.float64)
+    h = np.array(_view(hess_ptr, 0, n), np.float64)
+    bst.update(fobj=lambda preds, ds: (g, h))
+    return 0
+
+
+def booster_rollback_one_iter(handle: int) -> None:
+    _get(handle).rollback_one_iter()
+
+
+def _sync(bst: Booster) -> Booster:
+    """Materialize host trees from device state — the C API drives raw
+    update() calls, so predict/save/dump must see the current forest
+    (engine.train does this once at the end; here it's lazy per call)."""
+    gbdt = bst._gbdt
+    if gbdt is not None:
+        K = max(bst.num_model_per_iteration, 1)
+        expected = len(getattr(bst, "_prev_trees", [])) + gbdt.iter_ * K
+        if len(bst.trees) != expected:
+            bst._finalize()
+    return bst
+
+
+def booster_get_current_iteration(handle: int) -> int:
+    bst: Booster = _get(handle)
+    if bst._gbdt is not None:
+        return int(bst._gbdt.iter_)
+    return int(bst.current_iteration())
+
+
+def _metric_names(bst: Booster):
+    """Per-dataset metric names — the c_api contract counts METRICS, not
+    (dataset, metric) pairs (c_api.h GetEvalCounts/GetEvalNames)."""
+    gbdt = bst._gbdt
+    if gbdt is None:
+        return []
+    metrics = gbdt.valid_sets[0].metrics if gbdt.valid_sets else \
+        getattr(gbdt, "train_metrics", [])
+    return [m.name for m in metrics]
+
+
+def booster_get_eval_counts(handle: int) -> int:
+    return len(_metric_names(_get(handle)))
+
+
+def booster_get_eval_names(handle: int, ptrs_addr: int) -> int:
+    return _write_string_array(ptrs_addr, _metric_names(_get(handle)))
+
+
+def booster_get_eval(handle: int, data_idx: int, out_ptr: int) -> int:
+    """data_idx 0 = training, i+1 = i-th valid set (c_api.h:474)."""
+    bst: Booster = _get(handle)
+    gbdt = bst._gbdt
+    rows = gbdt.eval_all()
+    names = {0: "training"}
+    for i, vs in enumerate(gbdt.valid_sets):
+        names[i + 1] = vs.name
+    want = names.get(int(data_idx))
+    vals = [v for (d, _m, v, _h) in rows if d == want]
+    return _write_doubles(out_ptr, np.array(vals, np.float64))
+
+
+def booster_get_feature_names(handle: int, ptrs_addr: int) -> int:
+    return _write_string_array(ptrs_addr, _get(handle).feature_name())
+
+
+def booster_get_num_feature(handle: int) -> int:
+    return int(_get(handle).num_total_features)
+
+
+def booster_calc_num_predict(handle: int, num_row: int, predict_type: int,
+                             num_iteration: int) -> int:
+    bst: Booster = _sync(_get(handle))
+    K = max(bst.num_model_per_iteration, 1)
+    n_iter = bst.current_iteration() if num_iteration <= 0 else \
+        min(num_iteration, bst.current_iteration())
+    if predict_type == 2:       # leaf index
+        return num_row * K * n_iter
+    if predict_type == 3:       # contrib
+        return num_row * K * (bst.num_total_features + 1)
+    return num_row * K
+
+
+def _predict(bst: Booster, X, predict_type: int, num_iteration: int,
+             parameter: str, out_ptr: int) -> int:
+    _sync(bst)
+    kw = {}
+    p = _params(parameter)
+    if "pred_early_stop" in p:
+        kw["pred_early_stop"] = p["pred_early_stop"] in ("1", "true")
+    preds = bst.predict(
+        X, num_iteration=num_iteration if num_iteration > 0 else None,
+        raw_score=predict_type == 1, pred_leaf=predict_type == 2,
+        pred_contrib=predict_type == 3, **kw)
+    return _write_doubles(out_ptr, np.asarray(preds, np.float64))
+
+
+def booster_predict_for_mat(handle: int, data_ptr: int, data_type: int,
+                            nrow: int, ncol: int, is_row_major: int,
+                            predict_type: int, num_iteration: int,
+                            parameter: str, out_ptr: int) -> int:
+    flat = _view(data_ptr, data_type, nrow * ncol)
+    X = flat.reshape(nrow, ncol) if is_row_major else flat.reshape(ncol, nrow).T
+    return _predict(_get(handle), np.array(X, np.float64), predict_type,
+                    num_iteration, parameter, out_ptr)
+
+
+def booster_predict_for_csr(handle: int, indptr_ptr: int, indptr_type: int,
+                            indices_ptr: int, data_ptr: int, data_type: int,
+                            nindptr: int, nelem: int, num_col: int,
+                            predict_type: int, num_iteration: int,
+                            parameter: str, out_ptr: int) -> int:
+    import scipy.sparse as sp
+    indptr = _view(indptr_ptr, indptr_type, nindptr).astype(np.int64)
+    indices = _view(indices_ptr, 2, nelem)
+    data = _view(data_ptr, data_type, nelem)
+    csr = sp.csr_matrix((np.array(data, np.float64), np.array(indices),
+                         np.array(indptr)), shape=(nindptr - 1, num_col))
+    return _predict(_get(handle), csr, predict_type, num_iteration,
+                    parameter, out_ptr)
+
+
+def booster_predict_for_csc(handle: int, colptr_ptr: int, colptr_type: int,
+                            indices_ptr: int, data_ptr: int, data_type: int,
+                            ncolptr: int, nelem: int, num_row: int,
+                            predict_type: int, num_iteration: int,
+                            parameter: str, out_ptr: int) -> int:
+    import scipy.sparse as sp
+    colptr = _view(colptr_ptr, colptr_type, ncolptr).astype(np.int64)
+    indices = _view(indices_ptr, 2, nelem)
+    data = _view(data_ptr, data_type, nelem)
+    csc = sp.csc_matrix((np.array(data, np.float64), np.array(indices),
+                         np.array(colptr)), shape=(num_row, ncolptr - 1))
+    return _predict(_get(handle), csc.tocsr(), predict_type, num_iteration,
+                    parameter, out_ptr)
+
+
+def booster_predict_for_file(handle: int, data_filename: str,
+                             data_has_header: int, predict_type: int,
+                             num_iteration: int, parameter: str,
+                             result_filename: str) -> None:
+    from .io.file_io import load_data_file
+    p = _params(parameter)
+    if data_has_header:
+        p["has_header"] = "true"
+    X, _, _ = load_data_file(data_filename, p)
+    bst: Booster = _sync(_get(handle))
+    preds = bst.predict(
+        X, num_iteration=num_iteration if num_iteration > 0 else None,
+        raw_score=predict_type == 1, pred_leaf=predict_type == 2,
+        pred_contrib=predict_type == 3)
+    preds = np.atleast_2d(preds.T).T if preds.ndim == 1 else preds
+    with open(result_filename, "w") as fh:
+        for row in (preds if preds.ndim == 2 else preds[:, None]):
+            fh.write("\t".join(f"{v:.18g}" for v in np.atleast_1d(row)) + "\n")
+
+
+def booster_save_model(handle: int, num_iteration: int, filename: str) -> None:
+    _sync(_get(handle)).save_model(filename,
+                            num_iteration if num_iteration > 0 else None)
+
+
+def booster_save_model_to_string(handle: int, num_iteration: int,
+                                 buffer_len: int, out_ptr: int) -> int:
+    text = _sync(_get(handle)).model_to_string(
+        num_iteration if num_iteration > 0 else None)
+    return _write_string(out_ptr, text, buffer_len)
+
+
+def booster_dump_model(handle: int, num_iteration: int, buffer_len: int,
+                       out_ptr: int) -> int:
+    d = _sync(_get(handle)).dump_model(num_iteration if num_iteration > 0 else None)
+    return _write_string(out_ptr, json.dumps(d), buffer_len)
+
+
+def booster_get_leaf_value(handle: int, tree_idx: int, leaf_idx: int) -> float:
+    return float(_sync(_get(handle)).trees[int(tree_idx)].leaf_value[int(leaf_idx)])
+
+
+def booster_set_leaf_value(handle: int, tree_idx: int, leaf_idx: int,
+                           val: float) -> None:
+    bst: Booster = _sync(_get(handle))
+    bst.trees[int(tree_idx)].leaf_value[int(leaf_idx)] = val
+    bst._stacked_cache = None        # device predict caches copy leaf values
+
+
+def booster_feature_importance(handle: int, num_iteration: int,
+                               importance_type: int, out_ptr: int) -> int:
+    imp = _sync(_get(handle)).feature_importance(
+        "split" if importance_type == 0 else "gain")
+    return _write_doubles(out_ptr, np.asarray(imp, np.float64))
+
+
+def network_init(machines: str, local_listen_port: int, listen_time_out: int,
+                 num_machines: int) -> None:
+    from .config import Config
+    from .parallel.comm import init_distributed
+    cfg = Config.from_params({
+        "machines": machines, "local_listen_port": local_listen_port,
+        "time_out": max(listen_time_out, 1), "num_machines": num_machines})
+    init_distributed(cfg)
+
+
+def network_free() -> None:
+    pass        # the jax.distributed service lives for the process

@@ -51,12 +51,13 @@ def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
                  x_ref,               # [R, F] int32 bin codes (chunk)
                  slot_ref,            # [R, 1] i32 slot per row (-1 = masked)
                  w_ref,               # [R, ch] bf16 weight channels (chunk)
-                 out_ref,             # [SC, F*B] f32
-                 acc_ref,             # VMEM scratch [SC, F*B] f32
+                 out_ref,             # [SC, F*B] f32 — doubles as the VMEM
+                                      # accumulator (constant index_map keeps
+                                      # the block resident across grid steps)
                  *, chunk_rows: int, num_bins: int, num_features: int,
                  num_slots: int, f_block: int):
     i = pl.program_id(0)
-    n_chunks = pl.num_programs(0)
+    acc_ref = out_ref
 
     @pl.when(i == 0)
     def _init():
@@ -89,10 +90,6 @@ def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
                 preferred_element_type=jnp.float32)        # [SC, fb*B]
             sl = slice(f0 * num_bins, (f0 + fb) * num_bins)
             acc_ref[:, sl] += part
-
-    @pl.when(i == n_chunks - 1)
-    def _flush():
-        out_ref[:] = acc_ref[:]
 
 
 def hist_pallas(
@@ -144,7 +141,6 @@ def hist_pallas(
                 pl.BlockSpec((chunk_rows, ch), lambda i, n: (i, 0)),
             ],
             out_specs=pl.BlockSpec((SC, F * num_bins), lambda i, n: (0, 0)),
-            scratch_shapes=[pltpu.VMEM((SC, F * num_bins), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((SC, F * num_bins), jnp.float32),
         interpret=_INTERPRET,
@@ -175,14 +171,35 @@ def build_histograms_pallas(
     Pallas kernel (same signature/semantics — the GPU_DEBUG_COMPARE analog
     lives in tests/test_pallas_hist.py)."""
     if row_idx is not None:
-        # pending-prefix gather; garbage tail rows are masked via slot=-1
-        X = jnp.take(X, row_idx, axis=0)
-        grad = jnp.take(grad, row_idx)
-        hess = jnp.take(hess, row_idx)
-        included = jnp.take(included, row_idx)
-        leaf_id = jnp.take(leaf_id, row_idx)
-        pos = jnp.arange(X.shape[0], dtype=jnp.int32)
-        slot = jnp.where(pos < n_active, slot_of_leaf[leaf_id], -1)
+        # pending-prefix gather, bounded to active chunks only (the XLA
+        # path's dynamic-trip loop, histogram.py:129-139, applied to the
+        # GATHER; the matmuls stay in the kernel with the chunk skip)
+        N = X.shape[0]
+        R = min(chunk_rows, N)
+        n_chunks_active = jnp.minimum((n_active + R - 1) // R, N // R)
+        iota_r = jnp.arange(R, dtype=jnp.int32)
+
+        def gather_chunk(c, bufs):
+            Xb, gb, hb, ib, sb = bufs
+            sl = c * R
+            idx = jax.lax.dynamic_slice_in_dim(row_idx, sl, R)
+            chunk_slot = jnp.where(sl + iota_r < n_active,
+                                   slot_of_leaf[jnp.take(leaf_id, idx)], -1)
+            upd = jax.lax.dynamic_update_slice_in_dim
+            return (upd(Xb, jnp.take(X, idx, axis=0), sl, 0),
+                    upd(gb, jnp.take(grad, idx), sl, 0),
+                    upd(hb, jnp.take(hess, idx), sl, 0),
+                    upd(ib, jnp.take(included, idx), sl, 0),
+                    upd(sb, chunk_slot, sl, 0))
+
+        bufs = (jnp.zeros_like(X), jnp.zeros_like(grad),
+                jnp.zeros_like(hess), jnp.zeros_like(included),
+                jnp.full(N, -1, jnp.int32))
+        _, bufs = jax.lax.while_loop(
+            lambda c: c[0] < n_chunks_active,
+            lambda c: (c[0] + 1, gather_chunk(c[0], c[1])),
+            (jnp.asarray(0, jnp.int32), bufs))
+        X, grad, hess, included, slot = bufs
     else:
         slot = slot_of_leaf[leaf_id]
         n_active = None

@@ -1,0 +1,150 @@
+"""C API tests, driving the native shim through ctypes exactly like the
+reference's tests/c_api_test/test_.py drives lib_lightgbm.so."""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+SO = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                  "capi", "lib_lightgbm_tpu.so")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not os.path.exists(SO):
+        r = subprocess.run(["make", "-C", os.path.dirname(SO)],
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            pytest.skip(f"cannot build C API shim: {r.stderr[-500:]}")
+    L = ctypes.CDLL(SO)
+    L.LGBM_GetLastError.restype = ctypes.c_char_p
+    return L
+
+
+def _check(lib, ret):
+    assert ret == 0, lib.LGBM_GetLastError().decode()
+
+
+def test_c_api_train_predict_roundtrip(lib, tmp_path):
+    rng = np.random.RandomState(0)
+    n, f = 500, 6
+    X = np.ascontiguousarray(rng.rand(n, f), dtype=np.float64)
+    y = np.ascontiguousarray(
+        (X[:, 0] + X[:, 1] > 1.0).astype(np.float32))
+
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1,
+        b"max_bin=31", None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0))
+
+    nd = ctypes.c_int()
+    _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)))
+    assert nd.value == n
+    nf = ctypes.c_int()
+    _check(lib, lib.LGBM_DatasetGetNumFeature(ds, ctypes.byref(nf)))
+    assert nf.value == f
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 min_data_in_leaf=10 verbose=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)))
+    assert it.value == 5
+
+    # predict for mat
+    out_len = ctypes.c_int64()
+    preds = np.zeros(n, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 0, 0, b"",
+        ctypes.byref(out_len), preds.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == n
+    acc = np.mean((preds > 0.5) == (y > 0.5))
+    assert acc > 0.9, acc
+
+    # save / load / re-predict
+    model_path = str(tmp_path / "c_api_model.txt").encode()
+    _check(lib, lib.LGBM_BoosterSaveModel(bst, 0, model_path))
+    bst2 = ctypes.c_void_p()
+    niter = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterCreateFromModelfile(
+        model_path, ctypes.byref(niter), ctypes.byref(bst2)))
+    assert niter.value == 5
+    preds2 = np.zeros(n, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst2, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 0, 0, b"",
+        ctypes.byref(out_len), preds2.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(preds2, preds, rtol=1e-10)
+
+    # model string + importance
+    buf = ctypes.create_string_buffer(1 << 20)
+    slen = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, 0, ctypes.c_int64(len(buf)), ctypes.byref(slen), buf))
+    assert buf.value.decode().startswith("tree")
+    imp = np.zeros(f, np.float64)
+    _check(lib, lib.LGBM_BoosterFeatureImportance(
+        bst, 0, 0, imp.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert imp.sum() > 0
+
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_BoosterFree(bst2))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_api_csr_dataset(lib):
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(1)
+    csr = sp.random(400, 10, density=0.3, random_state=rng, format="csr")
+    y = np.ascontiguousarray(
+        (csr.toarray()[:, 0] > 0.1).astype(np.float32))
+    indptr = np.ascontiguousarray(csr.indptr, np.int32)
+    indices = np.ascontiguousarray(csr.indices, np.int32)
+    data = np.ascontiguousarray(csr.data, np.float64)
+
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromCSR(
+        indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(csr.nnz),
+        ctypes.c_int64(10), b"max_bin=31", None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 400, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbose=-1", ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(3):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    out_len = ctypes.c_int64()
+    preds = np.zeros(400, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForCSR(
+        bst, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(csr.nnz),
+        ctypes.c_int64(10), 0, 0, b"", ctypes.byref(out_len),
+        preds.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == 400
+    assert np.isfinite(preds).all()
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_api_error_reporting(lib):
+    bad = ctypes.c_void_p()
+    ret = lib.LGBM_DatasetCreateFromFile(b"/nonexistent/file.csv", b"",
+                                         None, ctypes.byref(bad))
+    assert ret == -1
+    assert len(lib.LGBM_GetLastError()) > 0
