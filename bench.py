@@ -1,24 +1,28 @@
-"""Benchmark harness: Higgs-config training throughput on one TPU chip.
+"""Benchmark harness: Higgs-config training throughput + accuracy on one TPU.
 
 Reference workload (BASELINE.md / docs/Experiments.rst:106): LightGBM CPU
 trains HIGGS (10.5M rows x 28 features) for 500 iterations with
 num_leaves=255, max_bin=255, lr=0.1 in 238.505 s on 2x E5-2670v3 =>
-10.5e6 * 500 / 238.505 = 22,012 Mrow-tree/s.
+10.5e6 * 500 / 238.505 = 22.0 Mrow-tree/s, AUC 0.845154
+(docs/Experiments.rst:127).
 
-This harness trains the same config on a synthetic Higgs-shaped dataset
-(dense floats, 28 features — histogram cost depends on shape, not values),
-measures steady-state wall-clock per boosting iteration on-device, and
-reports throughput in Mrow-tree/s. vs_baseline > 1 means faster than the
-reference CPU headline.
+This harness (round-3 honesty upgrade, VERDICT r2 #3):
+- trains the REAL scale: 10.5M rows x 28 features, synthetic HIGGS-like
+  with learnable nonlinear structure (histogram cost depends on shape, not
+  values; accuracy is gated by a parity check, not an absolute target);
+- measures steady-state wall-clock per boosting iteration on-device;
+- reports AUC on a held-out split alongside throughput — a throughput
+  number with no quality check can be satisfied by degenerate trees;
+- gates accuracy by WAVE-vs-EXACT parity (tpu_wave_size=1 is the
+  reference-ordering mode; the analog of the reference's GPU-parity table,
+  docs/GPU-Performance.rst:135-159), run at reduced scale to fit budget.
 
-Resilience (the axon tunnel can be wedged so badly that even jax.devices()
-blocks forever):
-- a SIGALRM watchdog bounds the whole run; on expiry the JSON still prints;
-- the backend is probed in a SUBPROCESS first (hang-proof), retried once;
-- every failure path prints the one-line JSON with an "error" field.
+Budget-adaptive: every phase checks the remaining watchdog budget and
+degrades gracefully (skipped phases are reported as null, never crash the
+JSON contract).
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "auc": ...}
 """
 import json
 import os
@@ -30,7 +34,7 @@ import traceback
 
 import numpy as np
 
-BASELINE_MROW_TREE_PER_S = 10.5e6 * 500 / 238.505 / 1e6   # 22,012
+BASELINE_MROW_TREE_PER_S = 10.5e6 * 500 / 238.505 / 1e6   # 22.0
 
 _PROBE_CODE = (
     "import jax, jax.numpy as jnp;"
@@ -63,27 +67,50 @@ def _probe_backend(retries=1, delay=10.0, timeout=90):
     raise RuntimeError(f"backend probe failed: {last}")
 
 
-def run_bench():
+def _higgs_like(n_rows, n_features=28, seed=0):
+    """Synthetic HIGGS-shaped binary problem with learnable nonlinear
+    structure (products / squares like the derived kinematic features)."""
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n_rows, n_features).astype(np.float32)
+    logit = (X[:, 0] * 4 - X[:, 1] * 2 + X[:, 2] * X[:, 3] * 3
+             + np.square(X[:, 4]) * 2 - X[:, 5] * X[:, 6] - 1.8)
+    y = (logit + rng.randn(n_rows).astype(np.float32) * 0.75 > 0).astype(
+        np.float32)
+    return X, y
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0.5
+    npos, nneg = int(pos.sum()), int((~pos).sum())
+    if npos == 0 or nneg == 0:
+        return 0.5
+    return float((ranks[pos].sum() - npos * (npos + 1) / 2) / (npos * nneg))
+
+
+def run_bench(deadline):
     platform = _probe_backend()
 
-    import jax                                          # noqa: F401
     import lightgbm_tpu as lgb
 
-    n_rows = int(2 ** 21)          # 2.1M rows: same per-pass regime as HIGGS
-    n_features = 28
-    rng = np.random.RandomState(0)
-    X = rng.rand(n_rows, n_features).astype(np.float32)
-    logit = X[:, 0] * 4 - X[:, 1] * 2 + X[:, 2] * X[:, 3] * 3 - 2
-    y = (logit + rng.randn(n_rows) * 0.5 > 0).astype(np.float32)
+    kernel = os.environ.get("LGBM_TPU_BENCH_KERNEL", "xla")
+    n_rows = int(os.environ.get("LGBM_TPU_BENCH_ROWS", str(10_500_000)))
+    n_holdout = 500_000
+    X, y = _higgs_like(n_rows + n_holdout)
+    Xt, yt = X[n_rows:], y[n_rows:]
+    X, y = X[:n_rows], y[:n_rows]
 
     params = dict(
         objective="binary", num_leaves=255, max_bin=255, learning_rate=0.1,
         min_data_in_leaf=100, verbose=-1, metric="none",
+        tpu_hist_kernel=kernel,
     )
     ds = lgb.Dataset(X, label=y)
     bst = lgb.Booster(params=params, train_set=ds)
 
-    warmup, timed = 3, 15
+    warmup, timed = 3, 12
     for _ in range(warmup):
         bst.update()
     # force all queued work to finish before starting the clock
@@ -93,19 +120,54 @@ def run_bench():
         bst.update()
     np.asarray(bst._gbdt.score).sum()
     elapsed = time.perf_counter() - t0
-
     mrow_tree_per_s = n_rows * timed / elapsed / 1e6
-    return {
+
+    result = {
         "metric": "higgs_train_throughput",
         "value": round(mrow_tree_per_s, 1),
         "unit": "Mrow-tree/s",
         "vs_baseline": round(mrow_tree_per_s / BASELINE_MROW_TREE_PER_S, 3),
         "platform": platform,
+        "rows": n_rows,
+        "kernel": kernel,
+        "auc": None,
+        "auc_parity_gap": None,
     }
+
+    # ---- AUC on held-out rows (quality alongside every perf claim) --------
+    if deadline() > 60:
+        bst._finalize()
+        result["auc"] = round(_auc(yt, bst.predict(Xt)), 6)
+        result["iters_for_auc"] = warmup + timed
+
+    # ---- wave-vs-exact parity gate at reduced scale -----------------------
+    # (tpu_wave_size=1 reproduces the reference's one-leaf-at-a-time order;
+    #  the delta is the analog of the CPU-vs-GPU AUC table)
+    if deadline() > 150:
+        n_small = 400_000
+        Xs, ys = X[:n_small], y[:n_small]
+        small = dict(params, num_leaves=63, metric="none")
+        b_wave = lgb.train(small, lgb.Dataset(Xs, label=ys),
+                           num_boost_round=15)
+        b_exact = lgb.train(dict(small, tpu_wave_size=1),
+                            lgb.Dataset(Xs, label=ys), num_boost_round=15)
+        auc_w = _auc(yt, b_wave.predict(Xt))
+        auc_e = _auc(yt, b_exact.predict(Xt))
+        gap = abs(auc_w - auc_e)
+        result["auc_parity_gap"] = round(gap, 6)
+        # reference GPU parity band: |CPU - GPU| AUC deltas are ~3e-5..1e-3
+        # (docs/GPU-Performance.rst:135-159); allow 2e-3 on 15 iters
+        result["auc_parity_ok"] = bool(gap < 2e-3)
+
+    return result
 
 
 def main():
     budget = int(os.environ.get("LGBM_TPU_BENCH_TIMEOUT", "540"))
+    t_start = time.time()
+
+    def deadline():
+        return budget - (time.time() - t_start) - 30      # safety margin
 
     def on_alarm(signum, frame):
         raise BenchTimeout(f"bench exceeded {budget}s (wedged backend?)")
@@ -118,7 +180,7 @@ def main():
     try:
         for attempt in range(2):
             try:
-                result = run_bench()
+                result = run_bench(deadline)
                 break
             except BenchTimeout:
                 raise
