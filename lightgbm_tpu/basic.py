@@ -298,6 +298,10 @@ class Booster:
 
     def _setup_train(self, train_set: Dataset) -> None:
         from .boosting import create_boosting
+        from .parallel.comm import init_distributed
+        # reference ordering: Network::Init precedes LoadData
+        # (application.cpp:167-178) so distributed bin finding sees the mesh
+        init_distributed(self.config)
         train_set.params.update(self.params)
         train_set.construct(self.config)
         cd = train_set.constructed

@@ -211,6 +211,54 @@ class ConstructedDataset:
         return ds
 
 
+def _find_bins(active: List[int], find_one,
+               config: Optional[Config] = None) -> Dict[int, "BinMapper"]:
+    """Run FindBin for every active feature — feature-sharded across hosts
+    under DISTRIBUTED TRAINING (reference distributed bin finding:
+    feature-partitioned FindBin + Allgather of serialized BinMappers,
+    dataset_loader.cpp:820-899). Each process computes the mappers of the
+    features it owns (round-robin by rank) and the pickled shards are
+    exchanged host-side through jax's coordination-service KV store, so
+    every process ends with identical mappers.
+
+    Gated on the lightgbm network config (num_machines > 1), NOT on ambient
+    jax state: a user's multi-process jax program that trains on a subset
+    of ranks must not enter a collective here."""
+    if config is None or getattr(config, "num_machines", 1) <= 1:
+        return {j: find_one(j) for j in active}
+    from .parallel.comm import distributed_client
+    client = distributed_client()
+    import jax
+    if client is None or jax.process_count() <= 1:
+        return {j: find_one(j) for j in active}
+
+    import pickle
+    rank, world = jax.process_index(), jax.process_count()
+    seq = _find_bins_seq[0]          # SPMD construct order is identical on
+    _find_bins_seq[0] += 1           # every process, so seq agrees
+    timeout_ms = int(getattr(config, "time_out", 120)) * 60 * 1000
+    mine = {j: find_one(j) for j in active if j % world == rank}
+    client.key_value_set_bytes(f"lgbm_binmappers/{seq}/{rank}",
+                               pickle.dumps(mine))
+    out: Dict[int, BinMapper] = dict(mine)
+    for r in range(world):
+        if r == rank:
+            continue
+        blob = client.blocking_key_value_get_bytes(
+            f"lgbm_binmappers/{seq}/{r}", timeout_ms)
+        out.update(pickle.loads(blob))
+    try:
+        # all ranks must have READ every shard before any key disappears
+        client.wait_at_barrier(f"lgbm_binmappers_done/{seq}", timeout_ms)
+        client.key_value_delete(f"lgbm_binmappers/{seq}/{rank}")
+    except Exception:
+        pass                         # best-effort server-side cleanup
+    return out
+
+
+_find_bins_seq = [0]
+
+
 def _csc_column(csc, j: int) -> Tuple[np.ndarray, np.ndarray]:
     """(row_indices, float64_values) of column ``j`` via indptr slicing —
     works for both scipy.sparse csc_matrix and the newer csc_array (which
@@ -281,17 +329,19 @@ def construct_dataset(
     # reference: filter_cnt = min_data_in_leaf * sample / num_data (dataset_loader.cpp:495)
     filter_cnt = int(config.min_data_in_leaf * total_sample_cnt / max(num_data, 1))
 
-    features: List[FeatureInfo] = []
-    for j in range(num_total_features):
-        if j in ignore_set:
-            continue
+    def _find_one(j: int) -> BinMapper:
         mapper = BinMapper()
         bin_type = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
-        mapper.find_bin(per_feature_samples[j], total_sample_cnt, config.max_bin,
-                        config.min_data_in_bin, filter_cnt, bin_type,
-                        config.use_missing, config.zero_as_missing)
-        if not mapper.is_trivial:
-            features.append(FeatureInfo(j, mapper))
+        mapper.find_bin(per_feature_samples[j], total_sample_cnt,
+                        config.max_bin, config.min_data_in_bin, filter_cnt,
+                        bin_type, config.use_missing, config.zero_as_missing)
+        return mapper
+
+    active = [j for j in range(num_total_features) if j not in ignore_set]
+    mappers_by_idx = _find_bins(active, _find_one, config)
+    features: List[FeatureInfo] = [
+        FeatureInfo(j, mappers_by_idx[j]) for j in active
+        if not mappers_by_idx[j].is_trivial]
     if not features:
         Log.warning("There are no meaningful features, as all feature values are constant.")
 

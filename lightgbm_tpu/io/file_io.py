@@ -258,15 +258,24 @@ def stream_construct_dataset(path: str, config, feature_names=None,
 
     sample_n = Xs.shape[0]
     filter_cnt = int(config.min_data_in_leaf * sample_n / max(total_rows, 1))
-    features: List[FeatureInfo] = []
-    for j in range(num_total_features):
+
+    def _find_one(j: int) -> BinMapper:
         mapper = BinMapper()
         bin_type = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
         mapper.find_bin(Xs[:, j], sample_n, config.max_bin,
                         config.min_data_in_bin, filter_cnt, bin_type,
                         config.use_missing, config.zero_as_missing)
-        if not mapper.is_trivial:
-            features.append(FeatureInfo(j, mapper))
+        return mapper
+
+    # feature-sharded + exchanged under distributed training, so machines
+    # loading pre-partitioned files agree on bin boundaries (the reference's
+    # distributed FindBin + Allgather, dataset_loader.cpp:820-899)
+    from ..dataset import _find_bins
+    active = list(range(num_total_features))
+    mappers_by_idx = _find_bins(active, _find_one, config)
+    features: List[FeatureInfo] = [
+        FeatureInfo(j, mappers_by_idx[j]) for j in active
+        if not mappers_by_idx[j].is_trivial]
     if not features:
         Log.warning("There are no meaningful features in %s", path)
 

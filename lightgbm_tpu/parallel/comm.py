@@ -404,6 +404,13 @@ def _local_rank(machines, local_listen_port: int) -> int:
         f"{local_listen_port}")
 
 
+def distributed_client():
+    """The jax coordination-service client, or None when not running under
+    jax.distributed (single probe point for the private-API access)."""
+    from jax._src import distributed as _dist
+    return _dist.global_state.client
+
+
 def init_distributed(config) -> bool:
     """Wire multi-host execution when the reference's network params are set
     (reference: Network::Init + rank discovery, application.cpp:167-178,
@@ -411,8 +418,7 @@ def init_distributed(config) -> bool:
     coordination service + XLA collectives over ICI/DCN instead of a TCP
     mesh). Returns True if running multi-process after the call."""
     import jax
-    from jax._src import distributed as _dist
-    if _dist.global_state.client is not None:
+    if distributed_client() is not None:
         return jax.process_count() > 1        # already initialized
     if getattr(config, "num_machines", 1) <= 1:
         return False

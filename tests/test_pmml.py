@@ -1,0 +1,69 @@
+"""PMML converter tests (reference capability: pmml/pmml.py)."""
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.pmml import model_to_pmml
+
+NS = {"p": "http://www.dmg.org/PMML-4_2"}
+FIX = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _eval_pmml_tree(node, row, fields):
+    """Walk one PMML TreeModel node for a row dict; returns leaf score."""
+    children = node.findall("p:Node", NS)
+    if not children:
+        return float(node.get("score"))
+    for child in children:
+        pred = child.find("p:SimplePredicate", NS)
+        if pred is not None:
+            v = row[pred.get("field")]
+            thr = float(pred.get("value"))
+            ok = v <= thr if pred.get("operator") == "lessOrEqual" else v > thr
+            if ok:
+                return _eval_pmml_tree(child, row, fields)
+            continue
+        sset = child.find("p:SimpleSetPredicate", NS)
+        if sset is not None:
+            vals = set((sset.find("p:Array", NS).text or "").split())
+            inside = str(int(row[sset.get("field")])) in vals
+            want_in = sset.get("booleanOperator") == "isIn"
+            if inside == want_in:
+                return _eval_pmml_tree(child, row, fields)
+            continue
+        if child.find("p:True", NS) is not None:
+            return _eval_pmml_tree(child, row, fields)
+    raise AssertionError("no predicate matched")
+
+
+def test_pmml_reproduces_raw_predictions():
+    bst = lgb.Booster(model_file=os.path.join(FIX, "model_regression.txt"))
+    xml_text = model_to_pmml(bst)
+    root = ET.fromstring(xml_text)
+    fields = [df.get("name") for df in
+              root.find("p:DataDictionary", NS).findall("p:DataField", NS)]
+    trees = root.findall(".//p:TreeModel", NS)
+    assert len(trees) == bst.num_trees()
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(20, bst.num_total_features) * 3
+    expect = bst.predict(X, raw_score=True)
+    names = bst.feature_name()
+    for i in range(X.shape[0]):
+        row = dict(zip(names, X[i]))
+        total = sum(
+            _eval_pmml_tree(t.find("p:Node", NS), row, fields) for t in trees)
+        assert abs(total - expect[i]) < 1e-6, (i, total, expect[i])
+
+
+def test_pmml_cli(tmp_path, capsys):
+    from lightgbm_tpu.io.pmml import main
+    out = str(tmp_path / "m.pmml")
+    main([os.path.join(FIX, "model_binary.txt"), out])
+    tree = ET.parse(out)
+    assert tree.getroot().tag.endswith("PMML")
+    with pytest.raises(SystemExit):
+        main([])
