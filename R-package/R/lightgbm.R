@@ -1,0 +1,226 @@
+# R bindings for lightgbm_tpu (reference surface: R-package/R/*.R, ~5.1k
+# LoC driving lib_lightgbm through .Call wrappers in src/lightgbm_R.cpp).
+#
+# Here the native core is the lightgbm_tpu Python package (JAX/XLA owns the
+# TPU), so the bridge is reticulate instead of .Call — every function below
+# maps 1:1 onto the Python API that the rest of this repo tests heavily.
+#
+# NOTE: the build image for this repo carries no R runtime, so these
+# bindings are exercised outside CI; the Python surface they delegate to is
+# covered by tests/.
+
+.lgb_env <- new.env(parent = emptyenv())
+
+.lgb_core <- function() {
+  if (is.null(.lgb_env$core)) {
+    .lgb_env$core <- reticulate::import("lightgbm_tpu", delay_load = FALSE)
+  }
+  .lgb_env$core
+}
+
+.lgb_np <- function() {
+  if (is.null(.lgb_env$np)) {
+    .lgb_env$np <- reticulate::import("numpy", delay_load = FALSE)
+  }
+  .lgb_env$np
+}
+
+.as_matrix <- function(data) {
+  if (is.character(data) && length(data) == 1L) return(data)   # file path
+  m <- as.matrix(data)
+  storage.mode(m) <- "double"
+  m
+}
+
+#' Construct a lightgbm Dataset (reference lgb.Dataset.R)
+lgb.Dataset <- function(data, params = list(), reference = NULL,
+                        colnames = NULL, categorical_feature = NULL,
+                        free_raw_data = FALSE, label = NULL, weight = NULL,
+                        group = NULL, init_score = NULL) {
+  core <- .lgb_core()
+  args <- list(
+    data = .as_matrix(data),
+    params = params,
+    free_raw_data = free_raw_data
+  )
+  if (!is.null(label)) args$label <- as.numeric(label)
+  if (!is.null(weight)) args$weight <- as.numeric(weight)
+  if (!is.null(group)) args$group <- as.integer(group)
+  if (!is.null(init_score)) args$init_score <- as.numeric(init_score)
+  if (!is.null(reference)) args$reference <- reference$py
+  if (!is.null(colnames)) args$feature_name <- as.list(colnames)
+  if (!is.null(categorical_feature)) {
+    args$categorical_feature <- as.list(categorical_feature)
+  }
+  obj <- list(py = do.call(core$Dataset, args))
+  class(obj) <- "lgb.Dataset"
+  obj
+}
+
+#' Validation dataset aligned with a training dataset
+lgb.Dataset.create.valid <- function(dataset, data, label = NULL, ...) {
+  lgb.Dataset(data, label = label, reference = dataset, ...)
+}
+
+setinfo <- function(dataset, name, info) {
+  py <- dataset$py
+  if (name == "label") py$set_label(as.numeric(info))
+  else if (name == "weight") py$set_weight(as.numeric(info))
+  else if (name == "group") py$set_group(as.integer(info))
+  else if (name == "init_score") py$set_init_score(as.numeric(info))
+  else stop("unknown info field: ", name)
+  invisible(dataset)
+}
+
+getinfo <- function(dataset, name) {
+  dataset$py$get_field(name)
+}
+
+.wrap_booster <- function(py) {
+  obj <- list(py = py)
+  class(obj) <- "lgb.Booster"
+  obj
+}
+
+#' Train a model (reference lgb.train.R)
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), early_stopping_rounds = NULL,
+                      verbose = 1L, init_model = NULL, ...) {
+  core <- .lgb_core()
+  args <- list(
+    params = params,
+    train_set = data$py,
+    num_boost_round = as.integer(nrounds)
+  )
+  if (length(valids)) {
+    args$valid_sets <- lapply(valids, function(v) v$py)
+    args$valid_names <- as.list(names(valids))
+  }
+  if (!is.null(early_stopping_rounds)) {
+    args$early_stopping_rounds <- as.integer(early_stopping_rounds)
+  }
+  if (!is.null(init_model)) {
+    args$init_model <- if (inherits(init_model, "lgb.Booster"))
+      init_model$py else init_model
+  }
+  args$verbose_eval <- verbose > 0L
+  .wrap_booster(do.call(core$train, args))
+}
+
+#' Simple sklearn-style entry point (reference lightgbm.R)
+lightgbm <- function(data, label = NULL, params = list(),
+                     nrounds = 100L, ...) {
+  ds <- lgb.Dataset(data, label = label)
+  lgb.train(params = params, data = ds, nrounds = nrounds, ...)
+}
+
+#' Cross validation (reference lgb.cv.R)
+lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
+                   stratified = TRUE, early_stopping_rounds = NULL, ...) {
+  core <- .lgb_core()
+  args <- list(
+    params = params,
+    train_set = data$py,
+    num_boost_round = as.integer(nrounds),
+    nfold = as.integer(nfold),
+    stratified = stratified
+  )
+  if (!is.null(early_stopping_rounds)) {
+    args$early_stopping_rounds <- as.integer(early_stopping_rounds)
+  }
+  do.call(core$cv, args)
+}
+
+#' Predict (reference lgb.Booster.R predict method)
+predict.lgb.Booster <- function(object, data, num_iteration = NULL,
+                                rawscore = FALSE, predleaf = FALSE,
+                                predcontrib = FALSE, ...) {
+  args <- list(
+    data = .as_matrix(data),
+    raw_score = rawscore,
+    pred_leaf = predleaf,
+    pred_contrib = predcontrib
+  )
+  if (!is.null(num_iteration)) args$num_iteration <- as.integer(num_iteration)
+  out <- do.call(object$py$predict, args)
+  if (is.null(dim(out))) as.numeric(out) else out
+}
+
+print.lgb.Booster <- function(x, ...) {
+  cat(sprintf("<lgb.Booster: %d trees, %d features>\n",
+              x$py$num_trees(), x$py$num_total_features))
+  invisible(x)
+}
+
+#' Load a model from file or string (reference readRDS.lgb.Booster.R /
+#' lgb.load)
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  core <- .lgb_core()
+  if (!is.null(filename)) {
+    .wrap_booster(core$Booster(model_file = filename))
+  } else if (!is.null(model_str)) {
+    .wrap_booster(core$Booster(model_str = model_str))
+  } else {
+    stop("either filename or model_str is required")
+  }
+}
+
+#' Save a model (reference lgb.save)
+lgb.save <- function(booster, filename, num_iteration = NULL) {
+  args <- list(filename = filename)
+  if (!is.null(num_iteration)) args$num_iteration <- as.integer(num_iteration)
+  do.call(booster$py$save_model, args)
+  invisible(booster)
+}
+
+#' Dump the model to JSON (reference lgb.dump)
+lgb.dump <- function(booster, num_iteration = NULL) {
+  args <- list()
+  if (!is.null(num_iteration)) args$num_iteration <- as.integer(num_iteration)
+  jsonlite_or_str <- do.call(booster$py$dump_model, args)
+  jsonlite_or_str
+}
+
+#' Feature importance (reference lgb.importance.R)
+lgb.importance <- function(model, percentage = TRUE) {
+  splits <- as.numeric(model$py$feature_importance("split"))
+  gains <- as.numeric(model$py$feature_importance("gain"))
+  out <- data.frame(
+    Feature = unlist(model$py$feature_name()),
+    Gain = if (percentage && sum(gains) > 0) gains / sum(gains) else gains,
+    Frequency = if (percentage && sum(splits) > 0)
+      splits / sum(splits) else splits,
+    stringsAsFactors = FALSE
+  )
+  out[order(-out$Gain), ]
+}
+
+#' Flat node table of one or all trees (reference lgb.model.dt.tree.R)
+lgb.model.dt.tree <- function(model, num_iteration = NULL) {
+  dump <- lgb.dump(model, num_iteration)
+  trees <- dump$tree_info
+  rows <- list()
+  walk <- function(node, tree_index, parent) {
+    if (!is.null(node$split_index)) {
+      rows[[length(rows) + 1L]] <<- data.frame(
+        tree_index = tree_index, node = node$split_index,
+        parent = parent, split_feature = node$split_feature,
+        threshold = as.character(node$threshold),
+        gain = node$split_gain, value = node$internal_value,
+        count = node$internal_count, leaf = FALSE,
+        stringsAsFactors = FALSE)
+      walk(node$left_child, tree_index, node$split_index)
+      walk(node$right_child, tree_index, node$split_index)
+    } else {
+      rows[[length(rows) + 1L]] <<- data.frame(
+        tree_index = tree_index, node = -1L - node$leaf_index,
+        parent = parent, split_feature = NA_integer_,
+        threshold = NA_character_, gain = NA_real_,
+        value = node$leaf_value,
+        count = if (is.null(node$leaf_count)) NA_real_ else node$leaf_count,
+        leaf = TRUE, stringsAsFactors = FALSE)
+    }
+  }
+  for (t in trees) walk(t$tree_structure, t$tree_index, NA_integer_)
+  do.call(rbind, rows)
+}
