@@ -35,6 +35,7 @@ from ..dataset import ConstructedDataset, Metadata
 from ..grower import GrowerSpec, TreeArrays, grow_tree
 from ..parallel.comm import make_parallel_context
 from ..metrics import Metric, create_metrics
+from ..utils.timer import TIMERS
 from ..objectives import Objective, create_objective
 from ..ops.predict import leaves_from_binned
 from ..tree import Tree, tree_from_device_arrays
@@ -422,10 +423,12 @@ class GBDT:
         return score, out_valid
 
     def train_one_iter(self) -> None:
-        score, out_valid = self._run_step(self.score, self.config.learning_rate)
-        self.score = score
-        for vi, vs in enumerate(self.valid_sets):
-            vs.score = jnp.stack(out_valid[vi])
+        with TIMERS("train_step"):
+            score, out_valid = self._run_step(self.score,
+                                              self.config.learning_rate)
+            self.score = score
+            for vi, vs in enumerate(self.valid_sets):
+                vs.score = jnp.stack(out_valid[vi])
 
     # ---------------------------------------------------- custom objective
 
@@ -559,6 +562,10 @@ class GBDT:
         return np.asarray(arr)
 
     def eval_all(self) -> List[Tuple[str, str, float, bool]]:
+        with TIMERS("metric_eval"):
+            return self._eval_all()
+
+    def _eval_all(self) -> List[Tuple[str, str, float, bool]]:
         out = []
         if self.config.is_training_metric and self.train_metrics:
             conv = self._fetch(self._convert(self.score))[:, : self.num_data]
@@ -583,7 +590,8 @@ class GBDT:
     def finalize_model(self) -> List[List[Tree]]:
         """Fetch device trees to host Tree objects (one transfer), fold the
         boost-from-average bias into the first tree (gbdt.cpp:445-447)."""
-        host = jax.device_get(self.models)
+        with TIMERS("finalize_fetch"):
+            host = jax.device_get(self.models)
         mappers = self.train_set.mappers
         rfi = self.train_set.real_feature_idx
         forest: List[List[Tree]] = []

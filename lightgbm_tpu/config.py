@@ -289,6 +289,10 @@ class Config:
     # histogram kernel: "xla" one-hot matmul | "pallas" fused VMEM-accumulator
     # kernel (ops/pallas_histogram.py, the OpenCL histogram256.cl analog)
     tpu_hist_kernel: str = "xla"
+    # per-phase wall-clock accumulators (reference TIMETAG) printed after
+    # training; tpu_profile_dir wraps training in a jax.profiler trace
+    tpu_time_tag: bool = False
+    tpu_profile_dir: str = ""
 
     def __post_init__(self):
         self._check()

@@ -116,29 +116,35 @@ def train(params: Dict[str, Any], train_set: Dataset,
     gbdt = booster._gbdt
     eval_needed = bool(gbdt.valid_sets) or gbdt.config.is_training_metric or callbacks_after
     best_iteration = 0
+    from .utils.timer import TIMERS, maybe_xla_trace
+    if config.tpu_time_tag:
+        TIMERS.enabled = True
     try:
-        for it in range(n_rounds):
-            for cb in callbacks_before:
-                cb(CallbackEnv(booster, params, it, 0, n_rounds, None))
-            if fobj is not None:
-                gbdt.train_one_iter_custom(fobj)
-            else:
-                gbdt.train_one_iter()
-            eval_results = []
-            if gbdt.valid_sets or gbdt.config.is_training_metric:
-                if (it + 1) % max(config.metric_freq, 1) == 0:
-                    eval_results = gbdt.eval_all()
-                    if feval is not None:
-                        eval_results.extend(_run_feval(feval, gbdt, booster))
-                    if gbdt._check_no_splits():
-                        break
-            for cb in callbacks_after:
-                cb(CallbackEnv(booster, params, it, 0, n_rounds, eval_results))
+        with maybe_xla_trace(config.tpu_profile_dir):
+            for it in range(n_rounds):
+                for cb in callbacks_before:
+                    cb(CallbackEnv(booster, params, it, 0, n_rounds, None))
+                if fobj is not None:
+                    gbdt.train_one_iter_custom(fobj)
+                else:
+                    gbdt.train_one_iter()
+                eval_results = []
+                if gbdt.valid_sets or gbdt.config.is_training_metric:
+                    if (it + 1) % max(config.metric_freq, 1) == 0:
+                        eval_results = gbdt.eval_all()
+                        if feval is not None:
+                            eval_results.extend(_run_feval(feval, gbdt, booster))
+                        if gbdt._check_no_splits():
+                            break
+                for cb in callbacks_after:
+                    cb(CallbackEnv(booster, params, it, 0, n_rounds,
+                                   eval_results))
     except EarlyStopException as e:
         best_iteration = e.best_iteration + 1
         booster.best_score = e.best_score
 
     booster._finalize()
+    TIMERS.dump()       # reference TIMETAG destructor dump (gbdt.cpp)
     if best_iteration:
         # best_iteration indexes the FULL forest (prev + new): predict()
         # slices self.trees from the front

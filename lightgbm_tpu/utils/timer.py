@@ -1,0 +1,78 @@
+"""Per-phase wall-clock accumulators — the reference's compile-time TIMETAG
+profiling (serial_tree_learner.cpp:10-37, gbdt.cpp TIMETAG blocks, dumped at
+destruction), re-shaped for the XLA execution model:
+
+The reference times boosting/bagging/tree/metric separately because they are
+separate host loops. Here gradients+bagging+growth+score-update fuse into
+ONE device dispatch, so the phases that exist are: dataset construction
+(binning/EFB, host), step dispatch (the fused train step), metric eval
+(host numpy), model finalize (device->host fetch), and prediction. Deeper
+per-op visibility comes from XLA's own tools: set ``tpu_profile_dir`` and
+each training run wraps in a ``jax.profiler.trace`` you can open in
+XProf/TensorBoard.
+
+Enable with config ``tpu_time_tag=true`` (or env LGBM_TPU_TIMETAG=1); the
+summary prints through Log.info when a Booster finishes training, like the
+reference's destructor dump.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict
+
+from .log import Log
+
+
+class Timers:
+    def __init__(self):
+        self.enabled = bool(os.environ.get("LGBM_TPU_TIMETAG"))
+        self.acc: Dict[str, float] = defaultdict(float)
+        self.cnt: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def __call__(self, phase: str):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.acc[phase] += time.perf_counter() - t0
+            self.cnt[phase] += 1
+
+    def reset(self) -> None:
+        self.acc.clear()
+        self.cnt.clear()
+
+    def summary(self) -> str:
+        if not self.acc:
+            return "TIMETAG: (no phases recorded)"
+        width = max(len(k) for k in self.acc)
+        lines = ["TIMETAG phase summary (seconds):"]
+        for k in sorted(self.acc, key=self.acc.get, reverse=True):
+            lines.append(f"  {k:<{width}}  {self.acc[k]:9.3f}s"
+                         f"  x{self.cnt[k]}")
+        return "\n".join(lines)
+
+    def dump(self) -> None:
+        if self.enabled and self.acc:
+            Log.info("%s", self.summary())
+
+
+TIMERS = Timers()
+
+
+@contextlib.contextmanager
+def maybe_xla_trace(profile_dir: str):
+    """jax.profiler trace wrapper — the deep-profiling hook (XProf), gated
+    on a non-empty directory (config tpu_profile_dir)."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(profile_dir):
+        yield

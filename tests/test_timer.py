@@ -1,0 +1,40 @@
+"""TIMETAG profiling subsystem (reference: compile-time TIMETAG accumulators,
+serial_tree_learner.cpp:10-37 / gbdt.cpp, dumped at destruction)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.timer import TIMERS, Timers
+
+
+def test_timers_accumulate_and_summarize():
+    t = Timers()
+    t.enabled = True
+    with t("phase_a"):
+        pass
+    with t("phase_a"):
+        pass
+    with t("phase_b"):
+        pass
+    assert t.cnt["phase_a"] == 2 and t.cnt["phase_b"] == 1
+    s = t.summary()
+    assert "phase_a" in s and "x2" in s
+    t.reset()
+    assert t.summary().startswith("TIMETAG: (no phases")
+
+
+def test_train_records_phases():
+    TIMERS.reset()
+    prev = TIMERS.enabled
+    try:
+        rng = np.random.RandomState(0)
+        X = rng.rand(300, 4)
+        y = (X[:, 0] > 0.5).astype(float)
+        lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 4,
+                   "tpu_time_tag": True, "metric": "binary_logloss"},
+                  lgb.Dataset(X, label=y), num_boost_round=2)
+        assert TIMERS.cnt["train_step"] == 2
+        assert TIMERS.cnt["dataset_construct"] >= 1
+        assert TIMERS.cnt["finalize_fetch"] >= 1
+    finally:
+        TIMERS.enabled = prev
+        TIMERS.reset()
