@@ -1,0 +1,25 @@
+# Developer entry points.
+#
+# check-fast is the MANDATORY pre-snapshot gate: the distributed learners,
+# wave-vs-exact parity, and an engine smoke — the tests that have caught
+# every shipped regression so far (the round-2 data-parallel breakage
+# shipped precisely because these didn't run before the snapshot).
+
+PYTEST := python -m pytest -q
+
+check-fast:
+	$(PYTEST) tests/test_parallel.py tests/test_wave_parity.py \
+	          tests/test_engine.py::test_binary tests/test_engine.py::test_regression \
+	          tests/test_multihost.py
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+check:
+	$(PYTEST) tests/
+
+capi:
+	$(MAKE) -C capi
+
+bench-cpu:
+	LGBM_TPU_BENCH_ROWS=400000 JAX_PLATFORMS=cpu python bench.py
+
+.PHONY: check-fast check capi bench-cpu

@@ -1,13 +1,15 @@
 """Round-3 profile: per-component cost of the wave loop at bench config.
 
 Measures on the real chip (N=2.1M, F=28, B=256, S=16 — the BENCH_r02 regime):
-  1. full-pass histogram, no compaction (scan, static trip count)
-  2. compacted histogram at several n_active fractions (dynamic while_loop)
-  3. compact_rows alone
-  4. split scan for 2S slots
-  5. grow_tree end-to-end, varying (row_compact, slots, chunk)
+  0. primitive costs: row gather, scatter(set), cumsum, stable argsort
+  1. full-pass histogram, XLA one-hot matmul (no compaction)
+  2. full-pass histogram, PALLAS kernel (no compaction)
+  3. compacted histogram at several n_active fractions, both kernels
+  4. compact_rows alone
+  5. split scan for 2S slots
+  6. grow_tree end-to-end, xla vs pallas, varying (row_compact, slots)
 
-Run: python exp/wave_profile.py [quick]
+Run: python -u exp/wave_profile.py [quick]   (prints incrementally)
 """
 import time
 import sys, os
@@ -18,6 +20,7 @@ import jax.numpy as jnp
 
 from lightgbm_tpu.grower import GrowerSpec, grow_tree
 from lightgbm_tpu.ops.histogram import build_histograms, compact_rows
+from lightgbm_tpu.ops.pallas_histogram import build_histograms_pallas
 from lightgbm_tpu.ops.split_finder import per_feature_best_numerical
 
 N = 2 ** 21
@@ -39,6 +42,10 @@ def timeit(fn, *args, reps=5):
     return (time.perf_counter() - t0) / reps
 
 
+def report(label, t):
+    print(f"{label:<48}: {t*1e3:8.2f} ms", flush=True)
+
+
 X = rng.randint(0, B, size=(N, F)).astype(np.uint8)
 Xd = jnp.asarray(X)
 g = jnp.asarray(rng.randn(N).astype(np.float32))
@@ -50,39 +57,63 @@ default_bin = jnp.zeros(F, jnp.int32)
 fok = jnp.ones(F, bool)
 is_cat = jnp.zeros(F, bool)
 
-# leaf ids spread over 32 leaves so slot masks are realistic
 leaf_id_np = rng.randint(0, 32, size=N).astype(np.int32)
 leaf_id = jnp.asarray(leaf_id_np)
+perm = jnp.asarray(rng.permutation(N).astype(np.int32))
 
 chunk = 32768
 
-# ---- 1. full pass, no compaction --------------------------------------------
-slot_all = jnp.zeros(L + 1, jnp.int32).at[:].set(-1)
-slot_all = slot_all.at[jnp.arange(16)].set(jnp.arange(16))  # 16 of 32 leaves pending
+# ---- 0. primitive costs -----------------------------------------------------
+t = timeit(jax.jit(lambda p: jnp.take(Xd, p, axis=0)), perm)
+report("0. row gather X[perm] (2M x 28 u8)", t)
+t = timeit(jax.jit(lambda p: jnp.take(g, p)), perm)
+report("0. gather g[perm] (2M f32)", t)
+t = timeit(jax.jit(lambda p: jnp.zeros(N, jnp.int32).at[p].set(p)), perm)
+report("0. scatter set (2M i32)", t)
+t = timeit(jax.jit(lambda x: jnp.cumsum(x)), leaf_id)
+report("0. cumsum (2M i32)", t)
+t = timeit(jax.jit(lambda x: jnp.argsort(x, stable=True)), leaf_id)
+report("0. stable argsort (2M i32)", t)
+
+# ---- 1/2. full pass, both kernels ------------------------------------------
+slot_all = jnp.full(L + 1, -1, jnp.int32).at[jnp.arange(16)].set(jnp.arange(16))
 t = timeit(jax.jit(lambda lid: build_histograms(
     Xd, g, h, inc, lid, slot_all, num_slots=S, num_bins_padded=B,
     chunk_rows=chunk)), leaf_id)
-print(f"1. full-pass hist (scan, no compact)           : {t*1e3:8.1f} ms")
+report("1. full-pass hist XLA", t)
+for pchunk in ([1024, 2048, 4096] if not quick else [2048]):
+    t = timeit(jax.jit(lambda lid, pc=pchunk: build_histograms_pallas(
+        Xd, g, h, inc, lid, slot_all, num_slots=S, num_bins_padded=B,
+        chunk_rows=pc)), leaf_id)
+    report(f"2. full-pass hist PALLAS chunk={pchunk}", t)
 
-# ---- 2. compacted at fractions ----------------------------------------------
+# ---- 3. compacted at fractions ---------------------------------------------
 for n_pending_leaves in ([16, 4, 1] if not quick else [4]):
     slot = jnp.full(L + 1, -1, jnp.int32).at[
         jnp.arange(n_pending_leaves)].set(jnp.arange(n_pending_leaves))
     frac = n_pending_leaves / 32
 
-    def run(lid, slot=slot):
+    def run_xla(lid, slot=slot):
         ri, na = compact_rows(lid, slot)
         return build_histograms(Xd, g, h, inc, lid, slot, num_slots=S,
                                 num_bins_padded=B, chunk_rows=chunk,
                                 row_idx=ri, n_active=na)
-    t = timeit(jax.jit(run), leaf_id)
-    print(f"2. compact hist, ~{frac:4.0%} rows active          : {t*1e3:8.1f} ms")
 
-# ---- 3. compact_rows alone --------------------------------------------------
+    def run_pl(lid, slot=slot):
+        ri, na = compact_rows(lid, slot)
+        return build_histograms_pallas(Xd, g, h, inc, lid, slot, num_slots=S,
+                                       num_bins_padded=B, chunk_rows=2048,
+                                       row_idx=ri, n_active=na)
+    t = timeit(jax.jit(run_xla), leaf_id)
+    report(f"3. compact hist XLA    ~{frac:4.0%} active", t)
+    t = timeit(jax.jit(run_pl), leaf_id)
+    report(f"3. compact hist PALLAS ~{frac:4.0%} active", t)
+
+# ---- 4. compact_rows alone --------------------------------------------------
 t = timeit(jax.jit(lambda lid: compact_rows(lid, slot_all)), leaf_id)
-print(f"3. compact_rows alone                          : {t*1e3:8.1f} ms")
+report("4. compact_rows alone", t)
 
-# ---- 4. split scan for 2S slots ---------------------------------------------
+# ---- 5. split scan ----------------------------------------------------------
 hist = jnp.asarray(rng.rand(2 * S, F, B, 3).astype(np.float32))
 pg = jnp.sum(hist[:, 0, :, 0], axis=-1)
 ph = jnp.sum(hist[:, 0, :, 1], axis=-1)
@@ -91,19 +122,24 @@ t = timeit(jax.jit(lambda hh: per_feature_best_numerical(
     hh, pg, ph, pc, num_bins, missing_code, default_bin, fok,
     lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=100.0,
     min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0)), hist)
-print(f"4. split scan 2S={2*S} slots                     : {t*1e3:8.1f} ms")
+report(f"5. split scan 2S={2*S} slots", t)
 
-# ---- 5. grow_tree end-to-end ------------------------------------------------
-configs = [(True, 16, 32768), (False, 16, 32768)]
+# ---- 6. grow_tree end-to-end ------------------------------------------------
+configs = [("xla", True, 16), ("pallas", True, 16), ("xla", False, 16),
+           ("pallas", False, 16)]
 if not quick:
-    configs += [(True, 16, 131072), (True, 32, 32768), (True, 8, 32768)]
-for rc, slots, ch in configs:
+    configs += [("pallas", True, 25), ("pallas", False, 25)]
+for kern, rc, slots in configs:
     spec = GrowerSpec(num_leaves=L, num_features=F, num_bins_padded=B,
-                      chunk_rows=ch, hist_slots=slots, wave_size=slots,
+                      chunk_rows=chunk if kern == "xla" else 2048,
+                      hist_slots=slots, wave_size=slots,
                       max_depth=0, lambda_l1=0.0, lambda_l2=0.0,
                       min_data_in_leaf=100.0, min_sum_hessian_in_leaf=1e-3,
-                      min_gain_to_split=0.0, row_compact=rc)
-    grow = jax.jit(lambda gg: grow_tree(Xd, gg, h, inc, fok, is_cat, num_bins,
-                                        missing_code, default_bin, spec))
+                      min_gain_to_split=0.0, row_compact=rc, hist_kernel=kern)
+    grow = jax.jit(lambda gg, spec=spec: grow_tree(
+        Xd, gg, h, inc, fok, is_cat, num_bins, missing_code, default_bin,
+        spec))
     t = timeit(grow, g, reps=3)
-    print(f"5. grow_tree compact={int(rc)} slots={slots:3d} chunk={ch:6d}: {t*1e3:8.1f} ms")
+    report(f"6. grow_tree {kern:<6} compact={int(rc)} slots={slots}", t)
+    thr = N / t / 1e6
+    print(f"   -> {thr:6.1f} Mrow-tree/s (baseline 22.0)", flush=True)

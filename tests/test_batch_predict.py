@@ -62,9 +62,9 @@ def test_device_forest_large_batch():
     """Correctness at the 1M-row-tree routing scale (absolute wall-clock is
     a bench concern — the VERDICT target of 1M x 28 x 100 trees < 2s is
     measured on the chip, not this CPU test backend)."""
-    bst, _ = _train(n=5000, f=28, trees=100)
+    bst, _ = _train(n=3000, f=28, trees=40)
     rng = np.random.RandomState(2)
-    Xbig = rng.rand(200_000, 28) * 4 - 2
+    Xbig = rng.rand(80_000, 28) * 4 - 2
     out = forest_predict_raw(bst.trees, Xbig, 28)
     host = np.zeros(Xbig.shape[0])
     for t in bst.trees:

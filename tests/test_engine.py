@@ -61,12 +61,12 @@ def test_multiclass():
     X, y = load_digits(n_class=10, return_X_y=True)
     X_train, X_test, y_train, y_test = _split(X, y)
     params = {"objective": "multiclass", "metric": "multi_logloss",
-              "num_class": 10, "verbose": -1}
+              "num_class": 10, "verbose": -1, "num_leaves": 15}
     ds = lgb.Dataset(X_train, label=y_train)
-    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    bst = lgb.train(params, ds, num_boost_round=15, verbose_eval=False)
     pred = bst.predict(X_test)
     assert pred.shape == (len(y_test), 10)
-    assert log_loss(y_test, pred) < 0.35
+    assert log_loss(y_test, pred) < 0.6
     acc = (pred.argmax(axis=1) == y_test).mean()
     assert acc > 0.9
 
@@ -77,7 +77,7 @@ def test_multiclass_ova():
     params = {"objective": "multiclassova", "metric": "multi_error",
               "num_class": 3, "verbose": -1, "min_data_in_leaf": 5}
     ds = lgb.Dataset(X_train, label=y_train)
-    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    bst = lgb.train(params, ds, num_boost_round=12, verbose_eval=False)
     pred = bst.predict(X_test)
     acc = (pred.argmax(axis=1) == y_test).mean()
     assert acc > 0.9
@@ -194,9 +194,10 @@ def test_objectives_run():
         ("binary", y_bin),
     ]
     for obj, y in cases:
-        params = {"objective": obj, "verbose": -1, "min_data_in_leaf": 5}
+        params = {"objective": obj, "verbose": -1, "min_data_in_leaf": 5,
+                  "num_leaves": 15}
         ds = lgb.Dataset(X, label=y)
-        bst = lgb.train(params, ds, num_boost_round=25, verbose_eval=False)
+        bst = lgb.train(params, ds, num_boost_round=8, verbose_eval=False)
         pred = bst.predict(X)
         assert np.isfinite(pred).all(), obj
 

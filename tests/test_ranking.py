@@ -24,7 +24,7 @@ def test_lambdarank_train():
     ds = lgb.Dataset(X, label=y, group=g.astype(int))
     valid = lgb.Dataset(Xt, label=yt, reference=ds, group=gt.astype(int))
     res = {}
-    bst = lgb.train(params, ds, num_boost_round=50, valid_sets=[valid],
+    bst = lgb.train(params, ds, num_boost_round=15, valid_sets=[valid],
                     evals_result=res, verbose_eval=False)
     ndcg3 = res["valid_0"]["ndcg@3"][-1]
     # reference sklearn test asserts ndcg@3 > 0.60 wait-room; be a bit strict
@@ -36,7 +36,7 @@ def test_lambdarank_train():
 def test_lgbm_ranker_sklearn():
     X, y, g, Xt, yt, gt = _load_rank_data()
     from lightgbm_tpu import LGBMRanker
-    rk = LGBMRanker(n_estimators=30, num_leaves=31, verbose=-1)
+    rk = LGBMRanker(n_estimators=8, num_leaves=15, verbose=-1)
     rk.fit(X, y, group=g.astype(int))
     pred = rk.predict(Xt)
     assert pred.shape == (len(yt),)
@@ -52,8 +52,8 @@ def test_lambdarank_cv_query_folds():
               "ndcg_eval_at": [3], "verbose": -1, "num_leaves": 15,
               "min_data_in_leaf": 20}
     ds = lgb.Dataset(X, label=y, group=g)
-    res = lgb.cv(params, ds, num_boost_round=5, nfold=3, seed=7)
-    assert "ndcg@3-mean" in res and len(res["ndcg@3-mean"]) == 5
+    res = lgb.cv(params, ds, num_boost_round=3, nfold=2, seed=7)
+    assert "ndcg@3-mean" in res and len(res["ndcg@3-mean"]) == 3
     assert all(0.0 < v <= 1.0 for v in res["ndcg@3-mean"])
 
 
