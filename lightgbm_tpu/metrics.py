@@ -1,9 +1,13 @@
-"""Evaluation metrics (host-side NumPy, float64).
+"""Evaluation metrics.
 
 Reference: src/metric/ factory metric.cpp:13-47 and the per-family headers.
-Metrics run on fetched scores at eval points (metric_freq), so they use f64
-host math — matching the reference's double accumulators — while the training
-loop stays on device.
+Metrics run at eval points (metric_freq). The pointwise family's ``loss``
+bodies are backend-polymorphic (the ``_xp`` dispatch below): the boosting
+driver evaluates them ON DEVICE from the live score tensor and fetches one
+scalar per metric — no full-vector device->host transfer per iteration
+(gbdt._eval_all device path). Rank/AUC/multiclass metrics fetch the
+converted scores and use f64 host math, matching the reference's double
+accumulators.
 
 Each metric returns a list of (name, value, is_higher_better).
 """
@@ -18,6 +22,15 @@ from .dataset import Metadata
 from .utils.log import Log
 
 MetricResult = Tuple[str, float, bool]
+
+
+def _xp(arr):
+    """numpy for host arrays, jax.numpy for device arrays — lets one loss
+    body serve both the host eval path and the device scalar path."""
+    if type(arr).__module__.startswith("jax"):
+        import jax.numpy as jnp
+        return jnp
+    return np
 
 
 def _wavg(loss: np.ndarray, weight: Optional[np.ndarray]) -> float:
@@ -77,52 +90,58 @@ class L1Metric(_PointwiseRegressionMetric):
     name = "l1"
 
     def loss(self, s, y):
-        return np.abs(s - y)
+        return _xp(s).abs(s - y)
 
 
 class HuberLossMetric(_PointwiseRegressionMetric):
     name = "huber"
 
     def loss(self, s, y):
+        xp = _xp(s)
         d = self.config.huber_delta
         diff = s - y
-        return np.where(np.abs(diff) <= d, 0.5 * diff * diff,
-                        d * (np.abs(diff) - 0.5 * d))
+        return xp.where(xp.abs(diff) <= d, 0.5 * diff * diff,
+                        d * (xp.abs(diff) - 0.5 * d))
 
 
 class FairLossMetric(_PointwiseRegressionMetric):
     name = "fair"
 
     def loss(self, s, y):
+        xp = _xp(s)
         c = self.config.fair_c
-        x = np.abs(s - y)
-        return c * x - c * c * np.log(1.0 + x / c)
+        x = xp.abs(s - y)
+        return c * x - c * c * xp.log(1.0 + x / c)
 
 
 class PoissonMetric(_PointwiseRegressionMetric):
     name = "poisson"
 
     def loss(self, s, y):
+        xp = _xp(s)
         eps = 1e-10
-        return s - y * np.log(np.maximum(s, eps))
+        return s - y * xp.log(xp.maximum(s, eps))
 
 
 class BinaryLoglossMetric(_PointwiseRegressionMetric):
     name = "binary_logloss"
 
     def loss(self, p, y):
+        xp = _xp(p)
         eps = 1e-15
-        p = np.clip(p, eps, 1.0 - eps)
+        p = xp.clip(p, eps, 1.0 - eps)
         is_pos = y > 0
-        return np.where(is_pos, -np.log(p), -np.log(1.0 - p))
+        return xp.where(is_pos, -xp.log(p), -xp.log(1.0 - p))
 
 
 class BinaryErrorMetric(_PointwiseRegressionMetric):
     name = "binary_error"
 
     def loss(self, p, y):
+        xp = _xp(p)
         is_pos = y > 0
-        return np.where(is_pos, p <= 0.5, p > 0.5).astype(np.float64)
+        return xp.where(is_pos, p <= 0.5, p > 0.5).astype(xp.float64
+            if xp is np else xp.float32)
 
 
 class AUCMetric(Metric):
@@ -254,9 +273,10 @@ class CrossEntropyMetric(_PointwiseRegressionMetric):
     name = "xentropy"
 
     def loss(self, p, y):
+        xp = _xp(p)
         eps = 1e-15
-        p = np.clip(p, eps, 1.0 - eps)
-        return -y * np.log(p) - (1.0 - y) * np.log(1.0 - p)
+        p = xp.clip(p, eps, 1.0 - eps)
+        return -y * xp.log(p) - (1.0 - y) * xp.log(1.0 - p)
 
 
 class CrossEntropyLambdaMetric(Metric):
@@ -276,12 +296,13 @@ class KLDivMetric(_PointwiseRegressionMetric):
     name = "kldiv"
 
     def loss(self, p, y):
+        xp = _xp(p)
         eps = 1e-15
-        p = np.clip(p, eps, 1.0 - eps)
-        yc = np.clip(y, eps, 1.0 - eps)
-        ey = np.where((y > 0) & (y < 1),
-                      y * np.log(yc) + (1.0 - y) * np.log(1.0 - yc), 0.0)
-        return ey - (y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+        p = xp.clip(p, eps, 1.0 - eps)
+        yc = xp.clip(y, eps, 1.0 - eps)
+        ey = xp.where((y > 0) & (y < 1),
+                      y * xp.log(yc) + (1.0 - y) * xp.log(1.0 - yc), 0.0)
+        return ey - (y * xp.log(p) + (1.0 - y) * xp.log(1.0 - p))
 
 
 METRIC_FACTORY = {
