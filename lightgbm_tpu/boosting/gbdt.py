@@ -164,7 +164,9 @@ class GBDT:
         ok = np.arange(F_pad) < F                           # padding features off
         self.feature_ok_base = self._put(ok)
 
-        slots = config.tpu_hist_slots or max(1, min(16, num_leaves - 1))
+        # auto slots: 25 x 5 bf16 channels = 125 matmul columns — one full
+        # MXU tile (128) — while quartering the wave count at 255 leaves
+        slots = config.tpu_hist_slots or max(1, min(25, num_leaves - 1))
         wave = config.tpu_wave_size or slots
         self.spec = GrowerSpec(
             num_leaves=num_leaves,

@@ -291,7 +291,13 @@ def grow_tree(
         # (reference data_parallel_tree_learner.cpp:148-163), identity
         # otherwise; output covers this device's feature block only.
         if spec.row_compact:
-            row_idx, n_active = compact_rows(state.leaf_id, slot_of_leaf)
+            # root wave histograms ALL rows — identity indexing skips the
+            # cumsum+scatter entirely there (it's the largest wave)
+            row_idx, n_active = jax.lax.cond(
+                state.num_leaves_cur == 1,
+                lambda: (jnp.arange(N, dtype=jnp.int32),
+                         jnp.asarray(N, jnp.int32)),
+                lambda: compact_rows(state.leaf_id, slot_of_leaf))
         else:
             row_idx = n_active = None
         if spec.hist_kernel == "pallas":
