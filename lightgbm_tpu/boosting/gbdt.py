@@ -183,6 +183,7 @@ class GBDT:
             min_gain_to_split=config.min_gain_to_split,
             row_compact=config.tpu_row_compact,
             hist_kernel=config.tpu_hist_kernel,
+            hist_hilo=config.tpu_hist_hilo,
             hist_bins=self._hist_bins,
             use_categorical=bool(meta["is_categorical"].any()),
             cat_smooth=config.cat_smooth,

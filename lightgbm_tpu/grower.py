@@ -136,6 +136,8 @@ class GrowerSpec:
                                   # space); 0 = num_bins_padded (unbundled)
     hist_kernel: str = "xla"      # "xla" (one-hot matmul) | "pallas" (fused
                                   # VMEM-accumulator kernel, ops/pallas_histogram.py)
+    hist_hilo: bool = True        # bf16 hi/lo channel pairs (~f32 sums) vs
+                                  # single bf16 (GPU-reference-style tradeoff)
     # categorical split search (reference config.h:230-234)
     use_categorical: bool = False
     cat_smooth: float = 10.0
@@ -306,12 +308,12 @@ def grow_tree(
                 X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
                 num_slots=S, num_bins_padded=B_hist,
                 chunk_rows=spec.chunk_rows, row_idx=row_idx,
-                n_active=n_active)
+                n_active=n_active, hilo=spec.hist_hilo)
         else:
             new_hist = build_histograms(
                 X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
                 num_slots=S, num_bins_padded=B_hist, chunk_rows=spec.chunk_rows,
-                row_idx=row_idx, n_active=n_active)
+                row_idx=row_idx, n_active=n_active, hilo=spec.hist_hilo)
         new_hist = comm.reduce_hist(new_hist)
 
         # ---- 3. cache write + sibling by subtraction -----------------------

@@ -29,8 +29,30 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-# channels per leaf slot: g_hi, g_lo, h_hi, h_lo, count
+# channels per leaf slot: g_hi, g_lo, h_hi, h_lo, count (hi/lo mode) or
+# g, h, count (tpu_hist_hilo=false — single bf16 rounding, the reference
+# GPU path's f32-and-accept-tiny-deltas tradeoff at 40% fewer columns)
 NUM_CHANNELS = 5
+NUM_CHANNELS_FAST = 3
+
+
+def weight_channels(grad, hess, included, hilo: bool):
+    """[N, ch] bf16 weight channels for the one-hot matmul."""
+    if hilo:
+        g_hi, g_lo = _split_hi_lo(grad)
+        h_hi, h_lo = _split_hi_lo(hess)
+        return jnp.stack([g_hi, g_lo, h_hi, h_lo,
+                          included.astype(jnp.bfloat16)], axis=-1)
+    return jnp.stack([grad.astype(jnp.bfloat16), hess.astype(jnp.bfloat16),
+                      included.astype(jnp.bfloat16)], axis=-1)
+
+
+def combine_channels(acc, hilo: bool):
+    """[..., ch] f32 accumulated channels -> [..., 3] (sum_g, sum_h, cnt)."""
+    if hilo:
+        return jnp.stack([acc[..., 0] + acc[..., 1],
+                          acc[..., 2] + acc[..., 3], acc[..., 4]], axis=-1)
+    return acc[..., :3]
 
 
 def _split_hi_lo(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -73,6 +95,7 @@ def build_histograms(
     chunk_rows: int,
     row_idx: jnp.ndarray = None,   # [N] i32 from compact_rows (optional)
     n_active: jnp.ndarray = None,  # i32 count of valid row_idx entries
+    hilo: bool = True,             # hi/lo bf16 channel pairs (~f32 sums)
 ) -> jnp.ndarray:
     """Returns hist [num_slots, F, num_bins_padded, 3] f32 (sum_g, sum_h, count).
 
@@ -85,7 +108,7 @@ def build_histograms(
     n_rows, num_features = X.shape
     assert n_rows % chunk_rows == 0, (n_rows, chunk_rows)
     n_chunks = n_rows // chunk_rows
-    ch = NUM_CHANNELS
+    ch = NUM_CHANNELS if hilo else NUM_CHANNELS_FAST
     compact = row_idx is not None
     iota_bins = jnp.arange(num_bins_padded, dtype=jnp.int32)[None, None, :]
     iota_slots = jnp.arange(num_slots, dtype=jnp.int32)[None, :]
@@ -111,9 +134,7 @@ def build_histograms(
             slot = slot_of_leaf[lc]                                # [R]
 
         slot_onehot = (slot[:, None] == iota_slots)               # [R, S] bool
-        g_hi, g_lo = _split_hi_lo(gc)
-        h_hi, h_lo = _split_hi_lo(hc)
-        w = jnp.stack([g_hi, g_lo, h_hi, h_lo, mc.astype(jnp.bfloat16)], axis=-1)  # [R, ch]
+        w = weight_channels(gc, hc, mc, hilo)                     # [R, ch]
         rhs = (slot_onehot[:, :, None].astype(jnp.bfloat16) * w[:, None, :]
                ).reshape(chunk_rows, num_slots * ch)              # [R, S*ch]
 
@@ -143,10 +164,7 @@ def build_histograms(
 
     acc = acc.reshape(num_features, num_bins_padded, num_slots, ch)
     acc = jnp.transpose(acc, (2, 0, 1, 3))                        # [S, F, B, ch]
-    sum_g = acc[..., 0] + acc[..., 1]
-    sum_h = acc[..., 2] + acc[..., 3]
-    cnt = acc[..., 4]
-    return jnp.stack([sum_g, sum_h, cnt], axis=-1)                # [S, F, B, 3]
+    return combine_channels(acc, hilo)                            # [S, F, B, 3]
 
 
 def root_sums(grad: jnp.ndarray, hess: jnp.ndarray, included: jnp.ndarray

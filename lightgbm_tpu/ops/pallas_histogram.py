@@ -42,7 +42,8 @@ if not _xb.is_known_platform("tpu"):
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .histogram import NUM_CHANNELS, _split_hi_lo
+from .histogram import (NUM_CHANNELS, NUM_CHANNELS_FAST,
+                        combine_channels, weight_channels)
 
 _INTERPRET = False   # flipped by tests on CPU
 
@@ -103,6 +104,7 @@ def hist_pallas(
     chunk_rows: int = 2048,
     n_active: Optional[jnp.ndarray] = None,   # i32: rows [0, n_active) matter
     f_block: int = 4,
+    hilo: bool = True,
 ) -> jnp.ndarray:
     """Returns hist [S, F, B, 3] f32 (sum_g, sum_h, count).
 
@@ -110,7 +112,7 @@ def hist_pallas(
     ``n_active`` — chunks fully past it skip compute (cheap DMA only).
     """
     N, F = X.shape
-    ch = NUM_CHANNELS
+    ch = NUM_CHANNELS if hilo else NUM_CHANNELS_FAST
     SC = num_slots * ch
     assert N % chunk_rows == 0, (N, chunk_rows)
     if n_active is None:
@@ -118,10 +120,7 @@ def hist_pallas(
 
     # weight channels only ([N, ch] bf16) — the [N, S*ch] slot-expanded rhs
     # is built per chunk inside the kernel, in VMEM
-    g_hi, g_lo = _split_hi_lo(grad)
-    h_hi, h_lo = _split_hi_lo(hess)
-    w = jnp.stack([g_hi, g_lo, h_hi, h_lo,
-                   included.astype(jnp.bfloat16)], axis=-1)       # [N, ch]
+    w = weight_channels(grad, hess, included, hilo)               # [N, ch]
 
     x_i32 = X.astype(jnp.int32)
     n_chunks = N // chunk_rows
@@ -148,10 +147,7 @@ def hist_pallas(
 
     acc = out.reshape(num_slots, ch, F, num_bins)
     acc = jnp.transpose(acc, (0, 2, 3, 1))                        # [S, F, B, ch]
-    sum_g = acc[..., 0] + acc[..., 1]
-    sum_h = acc[..., 2] + acc[..., 3]
-    cnt = acc[..., 4]
-    return jnp.stack([sum_g, sum_h, cnt], axis=-1)                # [S, F, B, 3]
+    return combine_channels(acc, hilo)                            # [S, F, B, 3]
 
 
 def build_histograms_pallas(
@@ -166,6 +162,7 @@ def build_histograms_pallas(
     chunk_rows: int,
     row_idx: jnp.ndarray = None,
     n_active: jnp.ndarray = None,
+    hilo: bool = True,
 ) -> jnp.ndarray:
     """Drop-in replacement for ops.histogram.build_histograms backed by the
     Pallas kernel (same signature/semantics — the GPU_DEBUG_COMPARE analog
@@ -205,4 +202,4 @@ def build_histograms_pallas(
         n_active = None
     return hist_pallas(X, slot, grad, hess, included, num_slots,
                        num_bins_padded, chunk_rows=min(chunk_rows, X.shape[0]),
-                       n_active=n_active)
+                       n_active=n_active, hilo=hilo)

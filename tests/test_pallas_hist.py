@@ -78,6 +78,30 @@ def test_train_with_pallas_kernel_matches_xla():
     np.testing.assert_allclose(p_p, p_x, rtol=1e-4, atol=1e-5)
 
 
+def test_fast_channels_close_to_hilo():
+    """tpu_hist_hilo=false (3 bf16 channels) stays close to the hi/lo sums —
+    the GPU reference's accepted-precision-tradeoff mode."""
+    X, g, h, inc, leaf_id = _data(seed=5)
+    S, B = 4, 32
+    slot_of_leaf = jnp.full(9, -1, jnp.int32).at[jnp.arange(4)].set(
+        jnp.arange(4))
+    full = build_histograms(X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S,
+                            num_bins_padded=B, chunk_rows=1024)
+    fast = build_histograms(X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S,
+                            num_bins_padded=B, chunk_rows=1024, hilo=False)
+    # counts exact; g/h within bf16 rounding of the summands
+    np.testing.assert_array_equal(np.asarray(fast[..., 2]),
+                                  np.asarray(full[..., 2]))
+    denom = np.abs(np.asarray(full[..., :2])) + 1.0
+    rel = np.abs(np.asarray(fast[..., :2]) - np.asarray(full[..., :2])) / denom
+    assert rel.max() < 0.05, rel.max()
+    fast_pl = ph.build_histograms_pallas(
+        X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S, num_bins_padded=B,
+        chunk_rows=1024, hilo=False)
+    np.testing.assert_allclose(np.asarray(fast_pl), np.asarray(fast),
+                               rtol=1e-5, atol=1e-4)
+
+
 def test_pallas_f32_precision_vs_f64():
     """hi/lo bf16 channels keep ~f32 accuracy on large sums."""
     X, g, h, inc, leaf_id = _data(n=8192, f=2, bins=8, leaves=1, seed=3)
