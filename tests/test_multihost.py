@@ -40,8 +40,9 @@ def test_two_process_data_parallel_matches_single_process(tmp_path):
     env.update({
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-        # drop the axon site hook: children are pure-CPU workers
-        "PYTHONPATH": "",
+        # repo root only: keeps lightgbm_tpu importable while dropping the
+        # axon site hook — children are pure-CPU workers
+        "PYTHONPATH": os.path.dirname(HERE),
     })
     procs = [subprocess.Popen(
         [sys.executable, os.path.join(HERE, "multihost_child.py"),
