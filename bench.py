@@ -95,7 +95,7 @@ def run_bench(deadline):
 
     import lightgbm_tpu as lgb
 
-    kernel = os.environ.get("LGBM_TPU_BENCH_KERNEL", "xla")
+    kernel = os.environ.get("LGBM_TPU_BENCH_KERNEL", "auto")
     n_rows = int(os.environ.get("LGBM_TPU_BENCH_ROWS", str(10_500_000)))
     n_holdout = 500_000
     X, y = _higgs_like(n_rows + n_holdout)

@@ -1,0 +1,185 @@
+"""Chained-dispatch profiler: true device-side costs for the wave-loop parts.
+
+wave_profile.py timed each op as (enqueue xN, one host fetch) — through the
+axon tunnel that bundles ~50-70 ms of dispatch/fetch overhead plus the cost
+of pulling the op's full output back to host, which made small ops look
+uniformly ~60 ms and the 59 MB X-gather look like 736 ms. Here every
+measurement chains `reps` *dependent* evaluations inside ONE jitted
+computation and fetches a single scalar:
+
+  - the loop carry perturbs the op's input through min(|c|, 0) — runtime
+    zero, but XLA cannot constant-fold it, so the body cannot be hoisted
+    out of the fori_loop or CSE'd;
+  - the op's full output is reduced to a scalar each iteration (keeps the
+    whole op live vs DCE; the reduce itself is a cheap VPU stream).
+
+Run: python -u exp/chain_profile.py [quick]
+"""
+import time
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.grower import GrowerSpec, grow_tree
+from lightgbm_tpu.ops.histogram import build_histograms, compact_rows
+from lightgbm_tpu.ops.pallas_histogram import build_histograms_pallas
+from lightgbm_tpu.ops.split_finder import per_feature_best_numerical
+
+N = 2 ** 21
+F = 28
+B = 256
+L = 255
+S = 16
+rng = np.random.RandomState(0)
+quick = "quick" in sys.argv[1:]
+
+
+def chain(step, *inputs, reps=5):
+    """step(c, izero, fzero, *inputs) -> new scalar carry. Returns s/rep."""
+
+    def body(i, c):
+        izero = jnp.minimum(jnp.abs(c).astype(jnp.int32), 0)
+        fzero = jnp.minimum(jnp.abs(c), 0.0)
+        return step(c, izero, fzero, *inputs)
+
+    run = jax.jit(lambda c0, *a: jax.lax.fori_loop(
+        0, reps, lambda i, c: body(i, c), c0))
+    float(run(jnp.float32(0), *inputs))           # compile + warm
+    t0 = time.perf_counter()
+    float(run(jnp.float32(0), *inputs))
+    return (time.perf_counter() - t0) / reps
+
+
+def report(label, t):
+    print(f"{label:<52}: {t*1e3:8.2f} ms", flush=True)
+
+
+X = rng.randint(0, B, size=(N, F)).astype(np.uint8)
+Xd = jnp.asarray(X)
+# 4 uint8 codes packed per int32 word — layout probe for the gather cost
+Xp = jnp.asarray(X[:, 0::4].astype(np.int32)
+                 | (X[:, 1::4].astype(np.int32) << 8)
+                 | (X[:, 2::4].astype(np.int32) << 16)
+                 | (X[:, 3::4].astype(np.int32) << 24))
+g = jnp.asarray(rng.randn(N).astype(np.float32))
+h = jnp.ones(N, jnp.float32)
+inc = jnp.ones(N, jnp.float32)
+num_bins = jnp.full(F, B, jnp.int32)
+missing_code = jnp.zeros(F, jnp.int32)
+default_bin = jnp.zeros(F, jnp.int32)
+fok = jnp.ones(F, bool)
+is_cat = jnp.zeros(F, bool)
+leaf_id = jnp.asarray(rng.randint(0, 32, size=N).astype(np.int32))
+perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+chunk = 32768
+
+# ---- loop overhead baseline -------------------------------------------------
+t = chain(lambda c, iz, fz: c + 1.0, reps=50)
+report("0. chained-loop overhead (per rep)", t)
+
+# ---- primitives -------------------------------------------------------------
+t = chain(lambda c, iz, fz, p: c + jnp.take(Xd, p + iz, axis=0).sum(
+    dtype=jnp.float32), perm)
+report("0. row gather X[perm] (2M x 28 u8)", t)
+t = chain(lambda c, iz, fz, p: c + jnp.take(Xp, p + iz, axis=0).sum(
+    dtype=jnp.float32), perm)
+report("0. row gather Xpacked[perm] (2M x 7 i32)", t)
+t = chain(lambda c, iz, fz, p: c + jnp.take(g, p + iz).sum(), perm)
+report("0. gather g[perm] (2M f32)", t)
+t = chain(lambda c, iz, fz, p: c + jnp.zeros(N, jnp.int32).at[p + iz].set(p)
+          .sum(dtype=jnp.float32) * 0 + c * 0 + 1, perm)
+report("0. scatter set (2M i32)", t)
+t = chain(lambda c, iz, fz, l: c + jnp.cumsum(l + iz)[-1].astype(jnp.float32),
+          leaf_id)
+report("0. cumsum (2M i32)", t)
+t = chain(lambda c, iz, fz, l: c + jnp.argsort(l + iz, stable=True)[-1]
+          .astype(jnp.float32), leaf_id)
+report("0. stable argsort (2M i32)", t)
+
+slot_all = jnp.full(L + 1, -1, jnp.int32).at[jnp.arange(S)].set(jnp.arange(S))
+t = chain(lambda c, iz, fz, l: c + compact_rows(l + iz, slot_all)[0][-1]
+          .astype(jnp.float32), leaf_id)
+report("4. compact_rows alone", t)
+
+# ---- full pass, both kernels, both precisions -------------------------------
+for hilo in (True, False):
+    tag = "hilo" if hilo else "fast"
+    t = chain(lambda c, iz, fz, l: c + build_histograms(
+        Xd, g + fz, h, inc, l, slot_all, num_slots=S, num_bins_padded=B,
+        chunk_rows=chunk, hilo=hilo).sum(), leaf_id, reps=3)
+    report(f"1. full-pass hist XLA    {tag}", t)
+    for pchunk in ([512, 1024] if not quick else [1024]):
+        try:
+            t = chain(lambda c, iz, fz, l: c + build_histograms_pallas(
+                Xd, g + fz, h, inc, l, slot_all, num_slots=S,
+                num_bins_padded=B, chunk_rows=pchunk, hilo=hilo).sum(),
+                leaf_id, reps=3)
+            report(f"2. full-pass hist PALLAS {tag} chunk={pchunk}", t)
+        except Exception as e:
+            print(f"2. PALLAS {tag} chunk={pchunk} FAILED: "
+                  f"{str(e)[:160]}", flush=True)
+
+# ---- compacted at fractions -------------------------------------------------
+for n_pending_leaves in ([16, 4, 1] if not quick else [4]):
+    slot = jnp.full(L + 1, -1, jnp.int32).at[
+        jnp.arange(n_pending_leaves)].set(jnp.arange(n_pending_leaves))
+    frac = n_pending_leaves / 32
+
+    def xla_step(c, iz, fz, l, slot=slot):
+        ri, na = compact_rows(l + iz, slot)
+        return c + build_histograms(
+            Xd, g + fz, h, inc, l, slot, num_slots=S, num_bins_padded=B,
+            chunk_rows=chunk, row_idx=ri, n_active=na).sum()
+
+    def pl_step(c, iz, fz, l, slot=slot):
+        ri, na = compact_rows(l + iz, slot)
+        return c + build_histograms_pallas(
+            Xd, g + fz, h, inc, l, slot, num_slots=S, num_bins_padded=B,
+            chunk_rows=1024, row_idx=ri, n_active=na).sum()
+
+    t = chain(xla_step, leaf_id, reps=3)
+    report(f"3. compact hist XLA    ~{frac:4.0%} active", t)
+    try:
+        t = chain(pl_step, leaf_id, reps=3)
+        report(f"3. compact hist PALLAS ~{frac:4.0%} active", t)
+    except Exception as e:
+        print(f"3. PALLAS compact {frac:4.0%} FAILED: {str(e)[:160]}",
+              flush=True)
+
+# ---- split scan -------------------------------------------------------------
+hist = jnp.asarray(rng.rand(2 * S, F, B, 3).astype(np.float32))
+pg = jnp.sum(hist[:, 0, :, 0], axis=-1)
+ph = jnp.sum(hist[:, 0, :, 1], axis=-1)
+pc = jnp.sum(hist[:, 0, :, 2], axis=-1)
+t = chain(lambda c, iz, fz, hh: c + per_feature_best_numerical(
+    hh + fz, pg, ph, pc, num_bins, missing_code, default_bin, fok,
+    lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=100.0,
+    min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0)[0].sum(), hist)
+report(f"5. split scan 2S={2*S} slots", t)
+
+# ---- grow_tree end-to-end ---------------------------------------------------
+configs = [("xla", True, 16), ("xla", False, 16),
+           ("pallas", True, 16), ("pallas", False, 16),
+           ("xla", True, 25), ("xla", False, 25),
+           ("pallas", False, 25)]
+if quick:
+    configs = [("xla", True, 16), ("pallas", False, 16)]
+for kern, rc, slots in configs:
+    spec = GrowerSpec(num_leaves=L, num_features=F, num_bins_padded=B,
+                      chunk_rows=chunk if kern == "xla" else 1024,
+                      hist_slots=slots, wave_size=slots,
+                      max_depth=0, lambda_l1=0.0, lambda_l2=0.0,
+                      min_data_in_leaf=100.0, min_sum_hessian_in_leaf=1e-3,
+                      min_gain_to_split=0.0, row_compact=rc, hist_kernel=kern)
+    try:
+        t = chain(lambda c, iz, fz, gg, spec=spec: c + grow_tree(
+            Xd, gg + fz, h, inc, fok, is_cat, num_bins, missing_code,
+            default_bin, spec)[1].sum().astype(jnp.float32), g, reps=3)
+    except Exception as e:
+        print(f"6. grow_tree {kern} compact={int(rc)} slots={slots} FAILED: "
+              f"{str(e)[:160]}", flush=True)
+        continue
+    report(f"6. grow_tree {kern:<6} compact={int(rc)} slots={slots}", t)
+    print(f"   -> {N / t / 1e6:6.1f} Mrow-tree/s (baseline 22.0)", flush=True)

@@ -100,7 +100,20 @@ class GBDT:
         # row padding: per-device rows must be a chunk multiple
         Drow = self.pctx.pad_rows_multiple()
         per_target = max((N + Drow - 1) // Drow, 1)
+        # "auto" kernel: the Pallas VMEM-accumulator kernel on real TPU, the
+        # XLA one-hot matmul elsewhere (incl. the CPU test mesh — Pallas
+        # interpret mode is orders of magnitude slower there)
+        hist_kernel = config.tpu_hist_kernel
+        if hist_kernel == "auto":
+            hist_kernel = ("pallas" if jax.default_backend()
+                           in ("tpu", "axon") else "xla")
+            Log.debug("tpu_hist_kernel=auto resolved to %s", hist_kernel)
         chunk = min(config.tpu_hist_chunk, _round_up(per_target, 256))
+        if hist_kernel == "pallas":
+            # measured fastest grid step AND safely inside the 16MB scoped
+            # VMEM limit (2048-row chunks OOM the in-kernel one-hot
+            # intermediates; exp/chain_profile.py)
+            chunk = min(chunk, 512)
         Npad = _round_up(per_target, chunk) * Drow
         self.num_data = N
         self.num_data_padded = Npad
@@ -182,7 +195,7 @@ class GBDT:
             min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
             min_gain_to_split=config.min_gain_to_split,
             row_compact=config.tpu_row_compact,
-            hist_kernel=config.tpu_hist_kernel,
+            hist_kernel=hist_kernel,
             hist_hilo=config.tpu_hist_hilo,
             hist_bins=self._hist_bins,
             use_categorical=bool(meta["is_categorical"].any()),

@@ -286,9 +286,10 @@ class Config:
     # prefix-compacted index gather (the analog of the reference's
     # smaller-leaf histogramming, serial_tree_learner.cpp:354-362)
     tpu_row_compact: bool = True
-    # histogram kernel: "xla" one-hot matmul | "pallas" fused VMEM-accumulator
-    # kernel (ops/pallas_histogram.py, the OpenCL histogram256.cl analog)
-    tpu_hist_kernel: str = "xla"
+    # histogram kernel: "auto" (pallas on TPU, xla elsewhere) | "xla"
+    # one-hot matmul | "pallas" fused VMEM-accumulator kernel
+    # (ops/pallas_histogram.py, the OpenCL histogram256.cl analog)
+    tpu_hist_kernel: str = "auto"
     # per-phase wall-clock accumulators (reference TIMETAG) printed after
     # training; tpu_profile_dir wraps training in a jax.profiler trace
     tpu_time_tag: bool = False
@@ -337,8 +338,9 @@ class Config:
             Log.fatal("Unknown boosting type %s", self.boosting_type)
         if self.tree_learner not in ("serial", "feature", "data", "voting"):
             Log.fatal("Unknown tree learner type %s", self.tree_learner)
-        if self.tpu_hist_kernel not in ("xla", "pallas"):
-            Log.fatal("Unknown tpu_hist_kernel %s (xla|pallas)", self.tpu_hist_kernel)
+        if self.tpu_hist_kernel not in ("auto", "xla", "pallas"):
+            Log.fatal("Unknown tpu_hist_kernel %s (auto|xla|pallas)",
+                      self.tpu_hist_kernel)
         if self.boosting_type in ("rf", "random_forest"):
             # reference: rf.hpp:18-29 — bagging is mandatory for random forest
             if not (self.bagging_freq > 0 and self.bagging_fraction < 1.0):

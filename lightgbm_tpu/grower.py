@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .ops.histogram import build_histograms, compact_rows, root_sums
+from .ops.histogram import build_histograms, root_sums
 from .ops.split_finder import SplitCandidates, leaf_output
 
 NEG_INF = -jnp.inf
@@ -292,28 +292,47 @@ def grow_tree(
         # then the distributed reduction: psum_scatter for data-parallel
         # (reference data_parallel_tree_learner.cpp:148-163), identity
         # otherwise; output covers this device's feature block only.
-        if spec.row_compact:
-            # root wave histograms ALL rows — identity indexing skips the
-            # cumsum+scatter entirely there (it's the largest wave)
-            row_idx, n_active = jax.lax.cond(
-                state.num_leaves_cur == 1,
-                lambda: (jnp.arange(N, dtype=jnp.int32),
-                         jnp.asarray(N, jnp.int32)),
-                lambda: compact_rows(state.leaf_id, slot_of_leaf))
-        else:
-            row_idx = n_active = None
-        if spec.hist_kernel == "pallas":
-            from .ops.pallas_histogram import build_histograms_pallas
-            new_hist = build_histograms_pallas(
-                X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
-                num_slots=S, num_bins_padded=B_hist,
-                chunk_rows=spec.chunk_rows, row_idx=row_idx,
-                n_active=n_active, hilo=spec.hist_hilo)
-        else:
-            new_hist = build_histograms(
+        def hist_pass(row_idx, n_active, slot_counts=None):
+            if spec.hist_kernel == "pallas":
+                from .ops.pallas_histogram import build_histograms_pallas
+                return build_histograms_pallas(
+                    X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
+                    num_slots=S, num_bins_padded=B_hist,
+                    chunk_rows=spec.chunk_rows, row_idx=row_idx,
+                    n_active=n_active, hilo=spec.hist_hilo,
+                    slot_counts=slot_counts)
+            return build_histograms(
                 X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
                 num_slots=S, num_bins_padded=B_hist, chunk_rows=spec.chunk_rows,
-                row_idx=row_idx, n_active=n_active, hilo=spec.hist_hilo)
+                row_idx=row_idx, n_active=n_active, hilo=spec.hist_hilo,
+                slot_counts=slot_counts)
+
+        if spec.row_compact:
+            # Adaptive: a compacted pass pays one stable argsort plus a
+            # random row gather per active row (~2.5x the per-row cost of the
+            # streaming masked pass), so it only wins when few rows are
+            # active. Measured breakeven on v5e is ~25% active
+            # (exp/chain_profile.py); early waves (incl. the root) therefore
+            # run the full masked pass, late waves the compacted one — the
+            # TPU analog of the reference histogramming only the smaller
+            # leaf's rows (serial_tree_learner.cpp:354-362).
+            slot_row = slot_of_leaf[state.leaf_id]               # [N] i32
+            n_active = jnp.sum((slot_row >= 0).astype(jnp.int32))
+
+            def compact_pass():
+                # rows grouped by slot, original order within a slot (stable)
+                key = jnp.where(slot_row >= 0, slot_row, jnp.int32(2 ** 30))
+                row_idx = jnp.argsort(key, stable=True).astype(jnp.int32)
+                counts = jnp.sum(
+                    (slot_row[:, None]
+                     == jnp.arange(S, dtype=jnp.int32)[None, :])
+                    .astype(jnp.int32), axis=0)
+                return hist_pass(row_idx, n_active, counts)
+
+            new_hist = jax.lax.cond(n_active * 4 < N, compact_pass,
+                                    lambda: hist_pass(None, None))
+        else:
+            new_hist = hist_pass(None, None)
         new_hist = comm.reduce_hist(new_hist)
 
         # ---- 3. cache write + sibling by subtraction -----------------------
@@ -417,34 +436,56 @@ def grow_tree(
         parent_cache = state.parent_cache.at[smaller].set(jnp.where(apply, p, L))
 
         # ---- 7. route rows of split leaves ---------------------------------
-        map_feat = jnp.full(L + 1, -1, jnp.int32).at[p].set(cand.feature[p], mode="drop")
-        map_thr = jnp.zeros(L + 1, jnp.int32).at[p].set(cand.threshold[p], mode="drop")
-        map_dl = jnp.zeros(L + 1, bool).at[p].set(cand.default_left[p], mode="drop")
-        map_right = jnp.zeros(L + 1, jnp.int32).at[p].set(q, mode="drop")
-        map_feat = map_feat.at[L].set(-1)
+        # One packed [L+1, 4] split table -> ONE random row-gather per row
+        # (measured: each separate [N] table-gather costs ~10-25 ms at 2M
+        # rows; the old 7-gather routing dominated the wave).  Columns:
+        #   0: split feature (-1 = leaf not split this wave)
+        #   1: threshold bin
+        #   2: missing bin code (-1 = feature has no missing bin) folded from
+        #      (missing_code, num_bins, default_bin) at split time — the
+        #      reference's NumericalDecision missing handling (tree.h:218)
+        #   3: right-child leaf | default_left<<30 | is_cat<<29
+        sf = cand.feature[p]
+        sf_safe = jnp.maximum(sf, 0)
+        mc_s, nb_s, db_s = (missing_code[sf_safe], num_bins[sf_safe],
+                            default_bin[sf_safe])
+        miss_bin = jnp.where(mc_s == 2, nb_s - 1,
+                             jnp.where(mc_s == 1, db_s, -1))
+        w3 = (q | jnp.where(cand.default_left[p], 1 << 30, 0)
+              | jnp.where(cand.is_cat[p], 1 << 29, 0))
+        table = jnp.full((L + 1, 4), -1, jnp.int32)
+        table = table.at[:, 1].set(0).at[:, 3].set(0)
+        rows = jnp.stack([sf, cand.threshold[p], miss_bin, w3], axis=-1)
+        table = table.at[p].set(rows, mode="drop").at[L].set(
+            jnp.array([-1, 0, -1, 0], jnp.int32))
 
         lid = state.leaf_id
-        f_row = map_feat[lid]                                     # [N]
+        packed = table[lid]                                       # [N, 4]
+        f_row = packed[:, 0]
+        thr_row = packed[:, 1]
+        miss_row = packed[:, 2]
+        right_row = packed[:, 3] & ((1 << 29) - 1)
+        dl_row = (packed[:, 3] & (1 << 30)) != 0
         f_safe = jnp.maximum(f_row, 0)
         if bundle is None:
-            x_bin = jnp.take_along_axis(X, f_safe[:, None], axis=1)[:, 0].astype(jnp.int32)
+            # split-feature bin via one-hot multiply-sum over the F lanes —
+            # a fused VPU stream, vs take_along_axis's per-row gather
+            f_onehot = f_safe[:, None] == jnp.arange(X.shape[1],
+                                                     dtype=jnp.int32)[None, :]
+            x_bin = jnp.sum(X.astype(jnp.int32) * f_onehot, axis=1)
         else:
             x_bin = decode_bundled_bin(X, f_safe, bundle, default_bin)
-        mcode = missing_code[f_safe]
-        nbin = num_bins[f_safe]
-        dbin = default_bin[f_safe]
-        is_missing = ((mcode == 2) & (x_bin == nbin - 1)) | ((mcode == 1) & (x_bin == dbin))
-        go_left = jnp.where(is_missing, map_dl[lid], x_bin <= map_thr[lid])
+        go_left = jnp.where(x_bin == miss_row, dl_row, x_bin <= thr_row)
         if spec.use_categorical:
             # categorical routing: bin in the split's left-set -> left
             # (reference Tree::CategoricalDecision, tree.h:257-284)
-            map_iscat = jnp.zeros(L + 1, bool).at[p].set(cand.is_cat[p], mode="drop")
+            cat_row = (packed[:, 3] & (1 << 29)) != 0
             map_mask = jnp.zeros((L + 1, B), bool).at[p].set(cand.cat_mask[p],
                                                             mode="drop")
             go_left_cat = jnp.take_along_axis(map_mask[lid], x_bin[:, None],
                                               axis=1)[:, 0]
-            go_left = jnp.where(map_iscat[lid], go_left_cat, go_left)
-        leaf_id = jnp.where((f_row >= 0), jnp.where(go_left, lid, map_right[lid]), lid)
+            go_left = jnp.where(cat_row, go_left_cat, go_left)
+        leaf_id = jnp.where((f_row >= 0), jnp.where(go_left, lid, right_row), lid)
 
         done = (n_apply == 0) | (state.num_leaves_cur + n_apply >= L)
         return GrowState(t, leaf_id, hist, sum_g, sum_h, cnt, leaf_depth,

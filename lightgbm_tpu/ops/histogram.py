@@ -61,6 +61,61 @@ def _split_hi_lo(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return hi, lo
 
 
+# ---- packed-row form for the compacted gather -------------------------------
+# A random row access to HBM costs ~25-35 ns regardless of width (measured,
+# exp/chain_profile.py), so the compacted pass gathers ONE [N, W] i32 array
+# holding everything it needs per row — bin codes (4 uint8 / 2 uint16 per
+# word) then the bf16 weight channels bitcast pairwise into i32 — instead of
+# four separate gathers of X/grad/hess/included. Packing itself is a
+# sequential O(N) write (~0.1 ms at 2M rows), paid per wave.
+
+def codes_per_word(dtype) -> int:
+    return 4 if dtype == jnp.uint8 else 2
+
+
+def pack_rows(X, grad, hess, included, hilo: bool) -> Tuple[jnp.ndarray, int]:
+    """Returns (packed [N, Fw + ceil(ch/2)] i32, Fw)."""
+    N, F = X.shape
+    cpw = codes_per_word(X.dtype)
+    Fw = (F + cpw - 1) // cpw
+    shift = 32 // cpw
+    Xi = X.astype(jnp.int32)
+    if Fw * cpw != F:
+        Xi = jnp.pad(Xi, ((0, 0), (0, Fw * cpw - F)))
+    Xi = Xi.reshape(N, Fw, cpw)
+    xw = Xi[..., 0]
+    for k in range(1, cpw):
+        xw = xw | (Xi[..., k] << (shift * k))                     # [N, Fw]
+    w = weight_channels(grad, hess, included, hilo)               # [N, ch]
+    if w.shape[1] % 2:
+        w = jnp.pad(w, ((0, 0), (0, 1)))
+    wi = jax.lax.bitcast_convert_type(
+        w.reshape(N, -1, 2), jnp.int32)                           # [N, ch2]
+    return jnp.concatenate([xw, wi], axis=1), Fw
+
+
+def unpack_codes(xw: jnp.ndarray, F: int, cpw: int) -> jnp.ndarray:
+    """[R, Fw] i32 packed words -> [R, F] i32 bin codes."""
+    shift = 32 // cpw
+    mask = (1 << shift) - 1
+    cols = [(xw >> (shift * k)) & mask for k in range(cpw)]
+    out = jnp.stack(cols, axis=-1).reshape(xw.shape[0], -1)       # [R, Fw*cpw]
+    return out[:, :F]
+
+
+def unpack_weights(wi: jnp.ndarray, ch: int) -> jnp.ndarray:
+    """[R, ch2] i32 -> [R, ch] bf16 weight channels."""
+    w = jax.lax.bitcast_convert_type(wi, jnp.bfloat16)            # [R, ch2, 2]
+    return w.reshape(wi.shape[0], -1)[:, :ch]
+
+
+def slot_from_position(pos: jnp.ndarray, slot_cum: jnp.ndarray) -> jnp.ndarray:
+    """Slot of each compacted position when row_idx is slot-grouped: slot s
+    spans positions [cum[s-1], cum[s]) — a VPU compare-sum, no row gather."""
+    return jnp.sum((pos[:, None] >= slot_cum[None, :]).astype(jnp.int32),
+                   axis=1)
+
+
 def compact_rows(leaf_id: jnp.ndarray, slot_of_leaf: jnp.ndarray
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Prefix-compact the indices of rows whose leaf is pending a histogram.
@@ -96,6 +151,9 @@ def build_histograms(
     row_idx: jnp.ndarray = None,   # [N] i32 from compact_rows (optional)
     n_active: jnp.ndarray = None,  # i32 count of valid row_idx entries
     hilo: bool = True,             # hi/lo bf16 channel pairs (~f32 sums)
+    slot_counts: jnp.ndarray = None,  # [S] i32: rows per slot when row_idx is
+                                   # SLOT-GROUPED — slots derive from position
+                                   # (2 fewer random gathers per active row)
 ) -> jnp.ndarray:
     """Returns hist [num_slots, F, num_bins_padded, 3] f32 (sum_g, sum_h, count).
 
@@ -113,18 +171,25 @@ def build_histograms(
     iota_bins = jnp.arange(num_bins_padded, dtype=jnp.int32)[None, None, :]
     iota_slots = jnp.arange(num_slots, dtype=jnp.int32)[None, :]
     iota_chunk = jnp.arange(chunk_rows, dtype=jnp.int32)
+    slot_cum = (jnp.cumsum(slot_counts) if slot_counts is not None else None)
+    if compact:
+        packed, Fw = pack_rows(X, grad, hess, included, hilo)
+        cpw = codes_per_word(X.dtype)
 
     def chunk_part(i, acc):
         sl = jax.lax.dynamic_slice_in_dim
         if compact:
             idx = sl(row_idx, i * chunk_rows, chunk_rows)
-            valid = (i * chunk_rows + iota_chunk) < n_active
-            xc = jnp.take(X, idx, axis=0)
-            gc = jnp.take(grad, idx)
-            hc = jnp.take(hess, idx)
-            mc = jnp.take(included, idx)
-            lc = jnp.take(leaf_id, idx)
-            slot = jnp.where(valid, slot_of_leaf[lc], -1)          # [R]
+            pos = i * chunk_rows + iota_chunk
+            valid = pos < n_active
+            pk = jnp.take(packed, idx, axis=0)                    # [R, W]
+            xc = unpack_codes(pk[:, :Fw], num_features, cpw)
+            w = unpack_weights(pk[:, Fw:], ch)                    # [R, ch]
+            if slot_cum is not None:
+                raw = slot_from_position(pos, slot_cum)
+            else:
+                raw = slot_of_leaf[jnp.take(leaf_id, idx)]
+            slot = jnp.where(valid, raw, -1)                       # [R]
         else:
             xc = sl(X, i * chunk_rows, chunk_rows)
             gc = sl(grad, i * chunk_rows, chunk_rows)
@@ -132,9 +197,9 @@ def build_histograms(
             mc = sl(included, i * chunk_rows, chunk_rows)
             lc = sl(leaf_id, i * chunk_rows, chunk_rows)
             slot = slot_of_leaf[lc]                                # [R]
+            w = weight_channels(gc, hc, mc, hilo)                  # [R, ch]
 
         slot_onehot = (slot[:, None] == iota_slots)               # [R, S] bool
-        w = weight_channels(gc, hc, mc, hilo)                     # [R, ch]
         rhs = (slot_onehot[:, :, None].astype(jnp.bfloat16) * w[:, None, :]
                ).reshape(chunk_rows, num_slots * ch)              # [R, S*ch]
 

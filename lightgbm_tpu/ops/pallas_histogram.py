@@ -42,21 +42,22 @@ if not _xb.is_known_platform("tpu"):
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .histogram import (NUM_CHANNELS, NUM_CHANNELS_FAST,
-                        combine_channels, weight_channels)
+from .histogram import (NUM_CHANNELS, NUM_CHANNELS_FAST, codes_per_word,
+                        combine_channels, pack_rows, slot_from_position,
+                        unpack_weights)
 
 _INTERPRET = False   # flipped by tests on CPU
 
 
 def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
-                 x_ref,               # [R, F] int32 bin codes (chunk)
+                 x_ref,               # [R, Fw] i32 PACKED bin-code words
                  slot_ref,            # [R, 1] i32 slot per row (-1 = masked)
                  w_ref,               # [R, ch] bf16 weight channels (chunk)
                  out_ref,             # [SC, F*B] f32 — doubles as the VMEM
                                       # accumulator (constant index_map keeps
                                       # the block resident across grid steps)
                  *, chunk_rows: int, num_bins: int, num_features: int,
-                 num_slots: int, f_block: int):
+                 num_slots: int, cpw: int):
     i = pl.program_id(0)
     acc_ref = out_ref
 
@@ -67,7 +68,6 @@ def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
     # chunk-level skip: all rows of this chunk are past the active prefix
     @pl.when(i * chunk_rows < n_active_ref[0])
     def _compute():
-        x = x_ref[:]                                       # [R, F] i32
         # slot-weight columns built IN VMEM (never round-tripped via HBM):
         # rhs[r, s*ch+c] = (slot[r]==s) * w[r, c]
         ch = w_ref.shape[1]
@@ -77,10 +77,16 @@ def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
         rhs = ((slot == iota_s).astype(jnp.bfloat16)
                * jnp.tile(w_ref[:], (1, num_slots)))       # [R, SC]
 
-        for f0 in range(0, num_features, f_block):
-            fb = min(f_block, num_features - f0)
-            # one-hot for fb features at once: [R, fb*B]
-            xs = x[:, f0:f0 + fb]                          # [R, fb]
+        shift = 32 // cpw
+        mask = (1 << shift) - 1
+        for wi in range((num_features + cpw - 1) // cpw):
+            f0 = wi * cpw
+            fb = min(cpw, num_features - f0)
+            # unpack this word's fb features, one-hot them: [R, fb*B]
+            word = x_ref[:, wi:wi + 1]                     # [R, 1] i32
+            xs = jnp.concatenate(
+                [(word >> (shift * k)) & mask for k in range(fb)],
+                axis=1)                                    # [R, fb]
             xb = jnp.repeat(xs, num_bins, axis=1)          # [R, fb*B]
             iota_b = jax.lax.broadcasted_iota(
                 jnp.int32, (chunk_rows, fb * num_bins), 1) % num_bins
@@ -94,40 +100,34 @@ def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
 
 
 def hist_pallas(
-    X: jnp.ndarray,            # [N, F] uint8/uint16 bin codes
+    Xw: jnp.ndarray,           # [N, Fw] i32 PACKED bin-code words
     slot: jnp.ndarray,         # [N] i32 histogram slot per row, -1 = skip
-    grad: jnp.ndarray,         # [N] f32
-    hess: jnp.ndarray,         # [N] f32
-    included: jnp.ndarray,     # [N] f32 0/1
+    w: jnp.ndarray,            # [N, ch] bf16 weight channels
     num_slots: int,
     num_bins: int,
-    chunk_rows: int = 2048,
+    num_features: int,
+    cpw: int,                  # codes per packed word (4 = uint8, 2 = uint16)
+    chunk_rows: int = 512,
     n_active: Optional[jnp.ndarray] = None,   # i32: rows [0, n_active) matter
-    f_block: int = 4,
-    hilo: bool = True,
 ) -> jnp.ndarray:
     """Returns hist [S, F, B, 3] f32 (sum_g, sum_h, count).
 
     The caller may pre-gather rows into a pending prefix and pass
     ``n_active`` — chunks fully past it skip compute (cheap DMA only).
     """
-    N, F = X.shape
-    ch = NUM_CHANNELS if hilo else NUM_CHANNELS_FAST
+    N, Fw = Xw.shape
+    ch = w.shape[1]
+    hilo = ch == NUM_CHANNELS
     SC = num_slots * ch
     assert N % chunk_rows == 0, (N, chunk_rows)
     if n_active is None:
         n_active = jnp.asarray(N, jnp.int32)
 
-    # weight channels only ([N, ch] bf16) — the [N, S*ch] slot-expanded rhs
-    # is built per chunk inside the kernel, in VMEM
-    w = weight_channels(grad, hess, included, hilo)               # [N, ch]
-
-    x_i32 = X.astype(jnp.int32)
     n_chunks = N // chunk_rows
 
     kernel = functools.partial(
         _hist_kernel, chunk_rows=chunk_rows, num_bins=num_bins,
-        num_features=F, num_slots=num_slots, f_block=min(f_block, F))
+        num_features=num_features, num_slots=num_slots, cpw=cpw)
 
     out = pl.pallas_call(
         kernel,
@@ -135,17 +135,19 @@ def hist_pallas(
             num_scalar_prefetch=1,
             grid=(n_chunks,),
             in_specs=[
-                pl.BlockSpec((chunk_rows, F), lambda i, n: (i, 0)),
+                pl.BlockSpec((chunk_rows, Fw), lambda i, n: (i, 0)),
                 pl.BlockSpec((chunk_rows, 1), lambda i, n: (i, 0)),
                 pl.BlockSpec((chunk_rows, ch), lambda i, n: (i, 0)),
             ],
-            out_specs=pl.BlockSpec((SC, F * num_bins), lambda i, n: (0, 0)),
+            out_specs=pl.BlockSpec(
+                (SC, num_features * num_bins), lambda i, n: (0, 0)),
         ),
-        out_shape=jax.ShapeDtypeStruct((SC, F * num_bins), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(
+            (SC, num_features * num_bins), jnp.float32),
         interpret=_INTERPRET,
-    )(n_active.reshape(1), x_i32, slot.reshape(N, 1), w)
+    )(n_active.reshape(1), Xw, slot.reshape(N, 1), w)
 
-    acc = out.reshape(num_slots, ch, F, num_bins)
+    acc = out.reshape(num_slots, ch, num_features, num_bins)
     acc = jnp.transpose(acc, (0, 2, 3, 1))                        # [S, F, B, ch]
     return combine_channels(acc, hilo)                            # [S, F, B, 3]
 
@@ -163,43 +165,58 @@ def build_histograms_pallas(
     row_idx: jnp.ndarray = None,
     n_active: jnp.ndarray = None,
     hilo: bool = True,
+    slot_counts: jnp.ndarray = None,   # [S] i32: row_idx is slot-grouped —
+                                       # slots derive from position (no
+                                       # leaf_id/slot_of_leaf row gathers)
 ) -> jnp.ndarray:
     """Drop-in replacement for ops.histogram.build_histograms backed by the
     Pallas kernel (same signature/semantics — the GPU_DEBUG_COMPARE analog
     lives in tests/test_pallas_hist.py)."""
+    N, F = X.shape
+    cpw = codes_per_word(X.dtype)
+    ch = NUM_CHANNELS if hilo else NUM_CHANNELS_FAST
+    packed, Fw = pack_rows(X, grad, hess, included, hilo)     # [N, Fw+ch2]
     if row_idx is not None:
-        # pending-prefix gather, bounded to active chunks only (the XLA
-        # path's dynamic-trip loop, histogram.py:129-139, applied to the
-        # GATHER; the matmuls stay in the kernel with the chunk skip)
-        N = X.shape[0]
-        R = min(chunk_rows, N)
-        n_chunks_active = jnp.minimum((n_active + R - 1) // R, N // R)
-        iota_r = jnp.arange(R, dtype=jnp.int32)
+        # pending-prefix gather, bounded to active chunks only — ONE random
+        # row gather from the packed array per active row (vs four separate
+        # X/g/h/inc gathers; a random HBM row access costs the same ~30 ns
+        # regardless of row width). Gather granularity (32k rows) is
+        # independent of the kernel grid step (512 rows). Rg must divide N
+        # or the tail rows would silently never be gathered.
+        Rg = min(32768, N)
+        while Rg > 1 and N % Rg:
+            Rg //= 2
+        n_chunks_active = jnp.minimum((n_active + Rg - 1) // Rg, N // Rg)
+        iota_r = jnp.arange(Rg, dtype=jnp.int32)
+        slot_cum = (jnp.cumsum(slot_counts) if slot_counts is not None
+                    else None)
 
         def gather_chunk(c, bufs):
-            Xb, gb, hb, ib, sb = bufs
-            sl = c * R
-            idx = jax.lax.dynamic_slice_in_dim(row_idx, sl, R)
-            chunk_slot = jnp.where(sl + iota_r < n_active,
-                                   slot_of_leaf[jnp.take(leaf_id, idx)], -1)
+            pb, sb = bufs
+            sl = c * Rg
+            idx = jax.lax.dynamic_slice_in_dim(row_idx, sl, Rg)
+            pos = sl + iota_r
+            if slot_cum is not None:
+                raw = slot_from_position(pos, slot_cum)
+            else:
+                raw = slot_of_leaf[jnp.take(leaf_id, idx)]
+            chunk_slot = jnp.where(pos < n_active, raw, -1)
             upd = jax.lax.dynamic_update_slice_in_dim
-            return (upd(Xb, jnp.take(X, idx, axis=0), sl, 0),
-                    upd(gb, jnp.take(grad, idx), sl, 0),
-                    upd(hb, jnp.take(hess, idx), sl, 0),
-                    upd(ib, jnp.take(included, idx), sl, 0),
+            return (upd(pb, jnp.take(packed, idx, axis=0), sl, 0),
                     upd(sb, chunk_slot, sl, 0))
 
-        bufs = (jnp.zeros_like(X), jnp.zeros_like(grad),
-                jnp.zeros_like(hess), jnp.zeros_like(included),
-                jnp.full(N, -1, jnp.int32))
+        bufs = (jnp.zeros_like(packed), jnp.full(N, -1, jnp.int32))
         _, bufs = jax.lax.while_loop(
             lambda c: c[0] < n_chunks_active,
             lambda c: (c[0] + 1, gather_chunk(c[0], c[1])),
             (jnp.asarray(0, jnp.int32), bufs))
-        X, grad, hess, included, slot = bufs
+        packed, slot = bufs
     else:
         slot = slot_of_leaf[leaf_id]
         n_active = None
-    return hist_pallas(X, slot, grad, hess, included, num_slots,
-                       num_bins_padded, chunk_rows=min(chunk_rows, X.shape[0]),
-                       n_active=n_active, hilo=hilo)
+    Xw = packed[:, :Fw]
+    w = unpack_weights(packed[:, Fw:], ch)
+    return hist_pallas(Xw, slot, w, num_slots, num_bins_padded,
+                       num_features=F, cpw=cpw,
+                       chunk_rows=min(chunk_rows, N),
+                       n_active=n_active)

@@ -62,6 +62,35 @@ def test_pallas_matches_xla_compacted():
                                   np.asarray(ref[..., 2]))
 
 
+def test_slot_grouped_position_slots_match():
+    """slot_counts path: rows pre-sorted by slot, slots derived from position
+    — must equal the per-row slot-gather path in BOTH kernels."""
+    X, g, h, inc, leaf_id = _data(seed=7)
+    S, B = 4, 32
+    slot_of_leaf = jnp.full(9, -1, jnp.int32).at[1].set(0).at[3].set(1).at[5].set(2)
+    slot_row = slot_of_leaf[leaf_id]
+    n_active = jnp.sum((slot_row >= 0).astype(jnp.int32))
+    key = jnp.where(slot_row >= 0, slot_row, jnp.int32(2 ** 30))
+    row_idx = jnp.argsort(key, stable=True).astype(jnp.int32)
+    counts = jnp.sum((slot_row[:, None] == jnp.arange(S)[None, :])
+                     .astype(jnp.int32), axis=0)
+    ref = build_histograms(X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S,
+                           num_bins_padded=B, chunk_rows=1024,
+                           row_idx=row_idx, n_active=n_active)
+    grouped = build_histograms(X, g, h, inc, leaf_id, slot_of_leaf,
+                               num_slots=S, num_bins_padded=B,
+                               chunk_rows=1024, row_idx=row_idx,
+                               n_active=n_active, slot_counts=counts)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+    grouped_pl = ph.build_histograms_pallas(
+        X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S, num_bins_padded=B,
+        chunk_rows=1024, row_idx=row_idx, n_active=n_active,
+        slot_counts=counts)
+    np.testing.assert_allclose(np.asarray(grouped_pl), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
 def test_train_with_pallas_kernel_matches_xla():
     """End-to-end: tpu_hist_kernel=pallas grows the same trees as xla."""
     import lightgbm_tpu as lgb
