@@ -5,6 +5,9 @@
 # every shipped regression so far (the round-2 data-parallel breakage
 # shipped precisely because these didn't run before the snapshot).
 
+# Timing on the 1-core CI box: full `check` is ~9 min after grower/kernel
+# changes (XLA recompiles dominate) and ~5 min warm via the persistent
+# compile cache in .jax_cache; `check-fast` is ~4 min cold.
 PYTEST := python -m pytest -q
 
 check-fast:
