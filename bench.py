@@ -93,16 +93,10 @@ def _auc(y, s):
 def run_bench(deadline):
     platform = _probe_backend()
 
-    import jax
     # persistent compile cache: remote TPU compiles of the train step take
     # minutes through the tunnel; a warm cache keeps them out of the budget
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(os.path.dirname(
-                              os.path.abspath(__file__)), ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
-        pass
+    from lightgbm_tpu.utils.cache import enable_compile_cache, repo_cache_dir
+    enable_compile_cache(repo_cache_dir())
 
     import lightgbm_tpu as lgb
 

@@ -11,10 +11,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__))), ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from lightgbm_tpu.utils.cache import enable_compile_cache, repo_cache_dir
+enable_compile_cache(repo_cache_dir())
 
 from lightgbm_tpu.grower import GrowerSpec, grow_tree
 

@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config import Config
 from ..dataset import ConstructedDataset, Metadata
 from ..grower import GrowerSpec, TreeArrays, grow_tree
+from ..ops.histogram import table_lookup
 from ..parallel.comm import make_parallel_context
 from ..metrics import Metric, create_metrics
 from ..utils.timer import TIMERS
@@ -400,12 +401,14 @@ class GBDT:
                     leaf_value=tree.leaf_value * shrinkage,
                     internal_value=tree.internal_value * shrinkage)
                 tree = self._tree_output_transform(tree)
-                new_scores.append(self._score_update(score[k], tree.leaf_value[leaf_ids], it))
+                new_scores.append(self._score_update(
+                    score[k], table_lookup(leaf_ids, tree.leaf_value), it))
                 for vi, vs in enumerate(self.valid_sets):
                     vleaf = leaves_from_binned(tree, vs.Xb, self.num_bins,
                                                self.missing_code, self.default_bin)
                     new_valid[vi][k] = self._score_update(
-                        new_valid[vi][k], tree.leaf_value[vleaf], it)
+                        new_valid[vi][k], table_lookup(vleaf, tree.leaf_value),
+                        it)
                 trees.append(tree)
                 nleaves.append(tree.num_leaves)
             out_score = jnp.stack(new_scores)

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .ops.histogram import build_histograms, root_sums
+from .ops.histogram import build_histograms, root_sums, table_lookup
 from .ops.split_finder import SplitCandidates, leaf_output
 
 NEG_INF = -jnp.inf
@@ -316,7 +316,7 @@ def grow_tree(
             # run the full masked pass, late waves the compacted one — the
             # TPU analog of the reference histogramming only the smaller
             # leaf's rows (serial_tree_learner.cpp:354-362).
-            slot_row = slot_of_leaf[state.leaf_id]               # [N] i32
+            slot_row = table_lookup(state.leaf_id, slot_of_leaf)  # [N] i32
             n_active = jnp.sum((slot_row >= 0).astype(jnp.int32))
 
             def compact_pass():
@@ -436,36 +436,35 @@ def grow_tree(
         parent_cache = state.parent_cache.at[smaller].set(jnp.where(apply, p, L))
 
         # ---- 7. route rows of split leaves ---------------------------------
-        # One packed [L+1, 4] split table -> ONE random row-gather per row
-        # (measured: each separate [N] table-gather costs ~10-25 ms at 2M
-        # rows; the old 7-gather routing dominated the wave).  Columns:
+        # One [L+1, 6] split table resolved per row by table_lookup's one-hot
+        # MXU matmul (each separate [N] table-gather costs ~10-25 ms at 2M
+        # rows; the old 7-gather routing dominated the wave). Columns:
         #   0: split feature (-1 = leaf not split this wave)
         #   1: threshold bin
         #   2: missing bin code (-1 = feature has no missing bin) folded from
         #      (missing_code, num_bins, default_bin) at split time — the
         #      reference's NumericalDecision missing handling (tree.h:218)
-        #   3: right-child leaf | default_left<<30 | is_cat<<29
+        #   3: right-child leaf   4: default_left   5: is_cat
         sf = cand.feature[p]
         sf_safe = jnp.maximum(sf, 0)
         mc_s, nb_s, db_s = (missing_code[sf_safe], num_bins[sf_safe],
                             default_bin[sf_safe])
         miss_bin = jnp.where(mc_s == 2, nb_s - 1,
                              jnp.where(mc_s == 1, db_s, -1))
-        w3 = (q | jnp.where(cand.default_left[p], 1 << 30, 0)
-              | jnp.where(cand.is_cat[p], 1 << 29, 0))
-        table = jnp.full((L + 1, 4), -1, jnp.int32)
-        table = table.at[:, 1].set(0).at[:, 3].set(0)
-        rows = jnp.stack([sf, cand.threshold[p], miss_bin, w3], axis=-1)
+        table = jnp.zeros((L + 1, 6), jnp.int32).at[:, 0].set(-1).at[:, 2].set(-1)
+        rows = jnp.stack([sf, cand.threshold[p], miss_bin, q,
+                          cand.default_left[p].astype(jnp.int32),
+                          cand.is_cat[p].astype(jnp.int32)], axis=-1)
         table = table.at[p].set(rows, mode="drop").at[L].set(
-            jnp.array([-1, 0, -1, 0], jnp.int32))
+            jnp.array([-1, 0, -1, 0, 0, 0], jnp.int32))
 
         lid = state.leaf_id
-        packed = table[lid]                                       # [N, 4]
+        packed = table_lookup(lid, table)                         # [N, 6]
         f_row = packed[:, 0]
         thr_row = packed[:, 1]
         miss_row = packed[:, 2]
-        right_row = packed[:, 3] & ((1 << 29) - 1)
-        dl_row = (packed[:, 3] & (1 << 30)) != 0
+        right_row = packed[:, 3]
+        dl_row = packed[:, 4] != 0
         f_safe = jnp.maximum(f_row, 0)
         if bundle is None:
             # split-feature bin via one-hot multiply-sum over the F lanes —
@@ -479,7 +478,7 @@ def grow_tree(
         if spec.use_categorical:
             # categorical routing: bin in the split's left-set -> left
             # (reference Tree::CategoricalDecision, tree.h:257-284)
-            cat_row = (packed[:, 3] & (1 << 29)) != 0
+            cat_row = packed[:, 5] != 0
             map_mask = jnp.zeros((L + 1, B), bool).at[p].set(cand.cat_mask[p],
                                                             mode="drop")
             go_left_cat = jnp.take_along_axis(map_mask[lid], x_bin[:, None],
@@ -499,4 +498,13 @@ def grow_tree(
         return wave(state)
 
     final = jax.lax.while_loop(cond, body, state)
-    return final.tree, final.leaf_id
+    # Scratch rows (leaf L, internal M) accumulate masked-split garbage that
+    # can be Inf/NaN (e.g. leaf_output with zero hessian). No row routes to
+    # them, but table_lookup's one-hot contraction reads every table row
+    # with weight 0 — and 0 * Inf = NaN. Zero them so downstream score
+    # updates stay exact; legitimate leaves are untouched.
+    tr = final.tree
+    tr = tr._replace(
+        leaf_value=tr.leaf_value.at[L].set(0.0),
+        internal_value=tr.internal_value.at[M].set(0.0))
+    return tr, final.leaf_id

@@ -116,6 +116,55 @@ def slot_from_position(pos: jnp.ndarray, slot_cum: jnp.ndarray) -> jnp.ndarray:
                    axis=1)
 
 
+def table_lookup(idx: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
+    """table[idx] for a SMALL table ([T<=1024, C]) as a one-hot f32 matmul.
+
+    XLA's TPU gather prices a per-row dynamic lookup at the random-access
+    tax (~15-25 ms for 2M rows — measured, exp/chain_profile.py) even when
+    the table is tiny; the one-hot [N, T] x [T, C] contraction is ~0.1 ms
+    on the MXU. Exact for values with |v| < 2^24 (f32 integer range) —
+    callers keep table entries inside that. Returns table.dtype.
+
+    CAVEAT: rows of the table that are never selected still flow through
+    the contraction with weight 0 — a non-finite entry there would poison
+    the result (0 * Inf = NaN). Callers must keep garbage rows finite
+    (grow_tree zeroes its scratch row before returning)."""
+    T = table.shape[0]
+    if T > 1024:          # one-hot width no longer trivial; gather wins back
+        return table[idx]
+    squeeze = table.ndim == 1
+    t2 = (table[:, None] if squeeze else table).astype(jnp.float32)
+    N = idx.shape[0]
+    # bound the materialized [N_c, T] one-hot operand to ~64 MB f32 — at
+    # bench scale (N=10.5M, T=256) an unchunked one-hot would be ~10.7 GB
+    n_chunk = max(256, (1 << 24) // T)
+
+    def lookup_block(ib):
+        onehot = (ib[:, None] == jnp.arange(T, dtype=ib.dtype)[None, :]
+                  ).astype(jnp.float32)
+        # HIGHEST precision: the f32 operand is decomposed into bf16
+        # triples whose reconstruction is exact (3x8 mantissa bits >=
+        # f32's 24), and the one-hot side is 0/1 — so the selected value
+        # comes back BIT-EXACT.
+        return jax.lax.dot_general(
+            onehot, t2,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+
+    if N <= n_chunk:
+        out = lookup_block(idx)
+    else:
+        n_blocks = (N + n_chunk - 1) // n_chunk
+        pad = n_blocks * n_chunk - N
+        idx_p = jnp.pad(idx, (0, pad)).reshape(n_blocks, n_chunk)
+        out = jax.lax.map(lookup_block, idx_p).reshape(-1, t2.shape[1])[:N]
+    if jnp.issubdtype(table.dtype, jnp.integer):
+        out = jnp.round(out)
+    out = out.astype(table.dtype)
+    return out[:, 0] if squeeze else out
+
+
 def compact_rows(leaf_id: jnp.ndarray, slot_of_leaf: jnp.ndarray
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Prefix-compact the indices of rows whose leaf is pending a histogram.
@@ -188,7 +237,7 @@ def build_histograms(
             if slot_cum is not None:
                 raw = slot_from_position(pos, slot_cum)
             else:
-                raw = slot_of_leaf[jnp.take(leaf_id, idx)]
+                raw = table_lookup(jnp.take(leaf_id, idx), slot_of_leaf)
             slot = jnp.where(valid, raw, -1)                       # [R]
         else:
             xc = sl(X, i * chunk_rows, chunk_rows)
@@ -196,7 +245,7 @@ def build_histograms(
             hc = sl(hess, i * chunk_rows, chunk_rows)
             mc = sl(included, i * chunk_rows, chunk_rows)
             lc = sl(leaf_id, i * chunk_rows, chunk_rows)
-            slot = slot_of_leaf[lc]                                # [R]
+            slot = table_lookup(lc, slot_of_leaf)                  # [R]
             w = weight_channels(gc, hc, mc, hilo)                  # [R, ch]
 
         slot_onehot = (slot[:, None] == iota_slots)               # [R, S] bool

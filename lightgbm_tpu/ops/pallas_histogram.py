@@ -44,7 +44,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .histogram import (NUM_CHANNELS, NUM_CHANNELS_FAST, codes_per_word,
                         combine_channels, pack_rows, slot_from_position,
-                        unpack_weights)
+                        table_lookup, unpack_weights)
 
 _INTERPRET = False   # flipped by tests on CPU
 
@@ -199,7 +199,7 @@ def build_histograms_pallas(
             if slot_cum is not None:
                 raw = slot_from_position(pos, slot_cum)
             else:
-                raw = slot_of_leaf[jnp.take(leaf_id, idx)]
+                raw = table_lookup(jnp.take(leaf_id, idx), slot_of_leaf)
             chunk_slot = jnp.where(pos < n_active, raw, -1)
             upd = jax.lax.dynamic_update_slice_in_dim
             return (upd(pb, jnp.take(packed, idx, axis=0), sl, 0),
@@ -212,7 +212,7 @@ def build_histograms_pallas(
             (jnp.asarray(0, jnp.int32), bufs))
         packed, slot = bufs
     else:
-        slot = slot_of_leaf[leaf_id]
+        slot = table_lookup(leaf_id, slot_of_leaf)
         n_active = None
     Xw = packed[:, :Fw]
     w = unpack_weights(packed[:, Fw:], ch)

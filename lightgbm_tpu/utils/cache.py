@@ -1,0 +1,24 @@
+"""Persistent XLA compile cache setup, shared by bench.py, exp/ profilers,
+and the driver entry points.
+
+Remote TPU compiles through the axon tunnel take minutes; a warm on-disk
+cache keeps them out of measurement/benchmark budgets. Safe to call on any
+JAX version — option names that don't exist are ignored.
+"""
+import os
+
+
+def enable_compile_cache(cache_dir: str) -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+
+def repo_cache_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".jax_cache")
