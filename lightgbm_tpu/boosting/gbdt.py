@@ -404,8 +404,10 @@ class GBDT:
                 new_scores.append(self._score_update(
                     score[k], table_lookup(leaf_ids, tree.leaf_value), it))
                 for vi, vs in enumerate(self.valid_sets):
-                    vleaf = leaves_from_binned(tree, vs.Xb, self.num_bins,
-                                               self.missing_code, self.default_bin)
+                    vleaf = leaves_from_binned(
+                        tree, vs.Xb, self.num_bins, self.missing_code,
+                        self.default_bin,
+                        use_categorical=spec.use_categorical)
                     new_valid[vi][k] = self._score_update(
                         new_valid[vi][k], table_lookup(vleaf, tree.leaf_value),
                         it)
