@@ -148,3 +148,50 @@ def test_pallas_f32_precision_vs_f64():
             assert abs(float(out[0, f, b, 0]) - gn[m].sum()) < 5e-3
             assert abs(float(out[0, f, b, 1]) - hn[m].sum()) < 5e-3
             assert float(out[0, f, b, 2]) == (m & (incn > 0)).sum()
+
+
+def test_uint16_codes_pack_roundtrip():
+    """max_bin > 255 stores uint16 codes (2 per packed word) — both kernels
+    and the pack/unpack helpers must agree with the uint8 semantics."""
+    from lightgbm_tpu.ops.histogram import (codes_per_word, pack_rows,
+                                            unpack_codes)
+    rng = np.random.RandomState(11)
+    n, f, bins = 2048, 5, 500
+    X = jnp.asarray(rng.randint(0, bins, size=(n, f)).astype(np.uint16))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    h = jnp.asarray(np.abs(rng.randn(n)).astype(np.float32))
+    inc = jnp.ones(n, jnp.float32)
+    assert codes_per_word(X.dtype) == 2
+    packed, Fw = pack_rows(X, g, h, inc, hilo=True)
+    codes = unpack_codes(packed[:, :Fw], f, 2)
+    np.testing.assert_array_equal(np.asarray(codes), np.asarray(X, np.int32))
+
+    leaf_id = jnp.asarray(rng.randint(0, 4, size=n).astype(np.int32))
+    slot_of_leaf = jnp.full(5, -1, jnp.int32).at[1].set(0).at[3].set(1)
+    B = 512
+    row_idx, n_active = compact_rows(leaf_id, slot_of_leaf)
+    ref = build_histograms(X, g, h, inc, leaf_id, slot_of_leaf, num_slots=2,
+                           num_bins_padded=B, chunk_rows=512)
+    cmp = build_histograms(X, g, h, inc, leaf_id, slot_of_leaf, num_slots=2,
+                           num_bins_padded=B, chunk_rows=512,
+                           row_idx=row_idx, n_active=n_active)
+    np.testing.assert_allclose(np.asarray(cmp), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+    out = ph.build_histograms_pallas(X, g, h, inc, leaf_id, slot_of_leaf,
+                                     num_slots=2, num_bins_padded=B,
+                                     chunk_rows=512)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_uint16_end_to_end_train():
+    """max_bin=400 trains through the uint16 dataset path."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(3)
+    X = rng.rand(3000, 4)
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 6) + 0.05 * rng.randn(3000)
+    m = lgb.train({"objective": "regression", "verbose": -1, "max_bin": 400,
+                   "num_leaves": 15, "min_data_in_leaf": 10},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    pred = m.predict(X)
+    assert np.mean((pred - y) ** 2) < np.var(y) * 0.3
