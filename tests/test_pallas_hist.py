@@ -151,9 +151,10 @@ def test_pallas_f32_precision_vs_f64():
 
 
 def test_uint16_codes_pack_roundtrip():
-    """max_bin > 255 stores uint16 codes (2 per packed word) — both kernels
-    and the pack/unpack helpers must agree with the uint8 semantics."""
-    from lightgbm_tpu.ops.histogram import (codes_per_word, pack_rows,
+    """max_bin > 255 stores uint16 codes (2 little-endian bytes per code in
+    the packed u8 rows) — both kernels and the pack/unpack helpers must
+    agree with the uint8 semantics."""
+    from lightgbm_tpu.ops.histogram import (code_bytes, pack_rows,
                                             unpack_codes)
     rng = np.random.RandomState(11)
     n, f, bins = 2048, 5, 500
@@ -161,9 +162,9 @@ def test_uint16_codes_pack_roundtrip():
     g = jnp.asarray(rng.randn(n).astype(np.float32))
     h = jnp.asarray(np.abs(rng.randn(n)).astype(np.float32))
     inc = jnp.ones(n, jnp.float32)
-    assert codes_per_word(X.dtype) == 2
-    packed, Fw = pack_rows(X, g, h, inc, hilo=True)
-    codes = unpack_codes(packed[:, :Fw], f, 2)
+    assert code_bytes(X.dtype) == 2
+    packed, ncb = pack_rows(X, g, h, inc, hilo=True)
+    codes = unpack_codes(packed[:, :ncb], f, 2)
     np.testing.assert_array_equal(np.asarray(codes), np.asarray(X, np.int32))
 
     leaf_id = jnp.asarray(rng.randint(0, 4, size=n).astype(np.int32))
