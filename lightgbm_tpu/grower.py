@@ -300,7 +300,10 @@ def grow_tree(
                     num_slots=S, num_bins_padded=B_hist,
                     chunk_rows=spec.chunk_rows, row_idx=row_idx,
                     n_active=n_active, hilo=spec.hist_hilo,
-                    slot_counts=slot_counts)
+                    slot_counts=slot_counts,
+                    # the adaptive cond only takes this path when
+                    # n_active*4 < N — grid + buffers shrink to match
+                    max_rows=(N + 3) // 4)
             return build_histograms(
                 X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
                 num_slots=S, num_bins_padded=B_hist, chunk_rows=spec.chunk_rows,

@@ -167,10 +167,19 @@ def build_histograms_pallas(
     slot_counts: jnp.ndarray = None,   # [S] i32: row_idx is slot-grouped —
                                        # slots derive from position (no
                                        # leaf_id/slot_of_leaf row gathers)
+    max_rows: int = 0,                 # STATIC cap on n_active (0 = N). The
+                                       # grower's adaptive cond guarantees
+                                       # n_active < N/4 on this path, so the
+                                       # kernel grid and gather buffers can
+                                       # shrink 4x — skipped grid steps are
+                                       # not free at a 10.5M-row full grid.
 ) -> jnp.ndarray:
     """Drop-in replacement for ops.histogram.build_histograms backed by the
     Pallas kernel (same signature/semantics — the GPU_DEBUG_COMPARE analog
-    lives in tests/test_pallas_hist.py)."""
+    lives in tests/test_pallas_hist.py).
+
+    With ``max_rows`` set, active rows beyond it are silently dropped — the
+    caller must guarantee n_active <= max_rows."""
     N, F = X.shape
     cb = code_bytes(X.dtype)
     ch = NUM_CHANNELS if hilo else NUM_CHANNELS_FAST
@@ -180,12 +189,16 @@ def build_histograms_pallas(
         # row gather from the packed array per active row (vs four separate
         # X/g/h/inc gathers; a random HBM row access costs the same ~30 ns
         # regardless of row width). Gather granularity (32k rows) is
-        # independent of the kernel grid step (512 rows). Rg must divide N
-        # or the tail rows would silently never be gathered.
-        Rg = min(32768, N)
-        while Rg > 1 and N % Rg:
+        # independent of the kernel grid step (512 rows). Rg must divide
+        # the buffer length or the tail rows would silently never be
+        # gathered.
+        cap = N if max_rows in (0, None) else min(max_rows, N)
+        R = min(chunk_rows, cap)
+        cap = ((cap + R - 1) // R) * R
+        Rg = min(32768, cap)
+        while Rg > 1 and cap % Rg:
             Rg //= 2
-        n_chunks_active = jnp.minimum((n_active + Rg - 1) // Rg, N // Rg)
+        n_chunks_active = jnp.minimum((n_active + Rg - 1) // Rg, cap // Rg)
         iota_r = jnp.arange(Rg, dtype=jnp.int32)
         slot_cum = (jnp.cumsum(slot_counts) if slot_counts is not None
                     else None)
@@ -204,18 +217,21 @@ def build_histograms_pallas(
             return (upd(pb, jnp.take(packed, idx, axis=0), sl, 0),
                     upd(sb, chunk_slot, sl, 0))
 
-        bufs = (jnp.zeros_like(packed), jnp.full(N, -1, jnp.int32))
+        bufs = (jnp.zeros((cap, packed.shape[1]), packed.dtype),
+                jnp.full(cap, -1, jnp.int32))
         _, bufs = jax.lax.while_loop(
             lambda c: c[0] < n_chunks_active,
             lambda c: (c[0] + 1, gather_chunk(c[0], c[1])),
             (jnp.asarray(0, jnp.int32), bufs))
         packed, slot = bufs
+        n_rows = cap
     else:
         slot = table_lookup(leaf_id, slot_of_leaf)
         n_active = None
+        n_rows = N
     Xb8 = packed[:, :ncb]
     w = unpack_weights(packed[:, ncb:], ch)
     return hist_pallas(Xb8, slot, w, num_slots, num_bins_padded,
                        num_features=F, cb=cb,
-                       chunk_rows=min(chunk_rows, N),
+                       chunk_rows=min(chunk_rows, n_rows),
                        n_active=n_active)

@@ -196,3 +196,24 @@ def test_uint16_end_to_end_train():
                   lgb.Dataset(X, label=y), num_boost_round=10)
     pred = m.predict(X)
     assert np.mean((pred - y) ** 2) < np.var(y) * 0.3
+
+
+def test_max_rows_capped_buffers_match():
+    """max_rows (static active-row cap) must not change results when
+    n_active fits under it."""
+    X, g, h, inc, leaf_id = _data(seed=9)
+    S, B = 4, 32
+    # one small leaf pending -> well under n/4 active
+    slot_of_leaf = jnp.full(9, -1, jnp.int32).at[2].set(0)
+    row_idx, n_active = compact_rows(leaf_id, slot_of_leaf)
+    ref = build_histograms(X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S,
+                           num_bins_padded=B, chunk_rows=512,
+                           row_idx=row_idx, n_active=n_active)
+    capped = ph.build_histograms_pallas(
+        X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S, num_bins_padded=B,
+        chunk_rows=512, row_idx=row_idx, n_active=n_active,
+        max_rows=X.shape[0] // 4)
+    np.testing.assert_allclose(np.asarray(capped), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(capped[..., 2]),
+                                  np.asarray(ref[..., 2]))
