@@ -147,6 +147,17 @@ def run_bench(deadline, attempt=0):
         "auc": None,
         "auc_parity_gap": None,
     }
+    # device memory alongside throughput (the reference reports peak RES /
+    # GPU memory: docs/Experiments.rst:158, docs/GPU-Performance.rst:183)
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+        if peak:
+            result["hbm_peak_gb"] = round(peak / 2 ** 30, 2)
+    except Exception:                                        # noqa: BLE001
+        pass
+
     # headline number exists from here on — if a later phase trips the
     # watchdog, main() still reports it
     _PARTIAL["result"] = dict(result)
