@@ -258,6 +258,17 @@ def grow_tree(
 
     rg, rh, rc = comm.reduce_scalars(*root_sums(grad, hess, included))
 
+    # one packed u8 row array per TREE (bin-code bytes + bf16 g/h channel
+    # bytes): the compacted waves gather rows from it with a single random
+    # access each; building it is an O(N) sequential write paid once here
+    # instead of per wave
+    if spec.row_compact:
+        from .ops.histogram import pack_rows
+        packed_rows, _ = pack_rows(X_hist, grad, hess, included,
+                                   spec.hist_hilo)
+    else:
+        packed_rows = None
+
     tree = _empty_tree(L, B)
     state = GrowState(
         tree=tree,
@@ -300,7 +311,7 @@ def grow_tree(
                     num_slots=S, num_bins_padded=B_hist,
                     chunk_rows=spec.chunk_rows, row_idx=row_idx,
                     n_active=n_active, hilo=spec.hist_hilo,
-                    slot_counts=slot_counts,
+                    slot_counts=slot_counts, packed=packed_rows,
                     # the adaptive cond only takes this path when
                     # n_active*4 < N — grid + buffers shrink to match
                     max_rows=(N + 3) // 4)
@@ -308,7 +319,7 @@ def grow_tree(
                 X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
                 num_slots=S, num_bins_padded=B_hist, chunk_rows=spec.chunk_rows,
                 row_idx=row_idx, n_active=n_active, hilo=spec.hist_hilo,
-                slot_counts=slot_counts)
+                slot_counts=slot_counts, packed=packed_rows)
 
         if spec.row_compact:
             # Adaptive: a compacted pass pays one stable argsort plus a

@@ -198,6 +198,9 @@ def build_histograms(
     slot_counts: jnp.ndarray = None,  # [S] i32: rows per slot when row_idx is
                                    # SLOT-GROUPED — slots derive from position
                                    # (2 fewer random gathers per active row)
+    packed: jnp.ndarray = None,    # pre-built pack_rows(X, grad, hess,
+                                   # included) — pass to amortize the O(N)
+                                   # pack across waves of one tree
 ) -> jnp.ndarray:
     """Returns hist [num_slots, F, num_bins_padded, 3] f32 (sum_g, sum_h, count).
 
@@ -217,7 +220,9 @@ def build_histograms(
     iota_chunk = jnp.arange(chunk_rows, dtype=jnp.int32)
     slot_cum = (jnp.cumsum(slot_counts) if slot_counts is not None else None)
     if compact:
-        packed, ncb = pack_rows(X, grad, hess, included, hilo)
+        if packed is None:
+            packed, _ = pack_rows(X, grad, hess, included, hilo)
+        ncb = X.shape[1] * code_bytes(X.dtype)
         cb = code_bytes(X.dtype)
 
     def chunk_part(i, acc):

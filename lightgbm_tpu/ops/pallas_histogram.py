@@ -167,6 +167,8 @@ def build_histograms_pallas(
     slot_counts: jnp.ndarray = None,   # [S] i32: row_idx is slot-grouped —
                                        # slots derive from position (no
                                        # leaf_id/slot_of_leaf row gathers)
+    packed: jnp.ndarray = None,        # pre-built pack_rows output (amortize
+                                       # the O(N) pack across a tree's waves)
     max_rows: int = 0,                 # STATIC cap on n_active (0 = N). The
                                        # grower's adaptive cond guarantees
                                        # n_active < N/4 on this path, so the
@@ -183,7 +185,9 @@ def build_histograms_pallas(
     N, F = X.shape
     cb = code_bytes(X.dtype)
     ch = NUM_CHANNELS if hilo else NUM_CHANNELS_FAST
-    packed, ncb = pack_rows(X, grad, hess, included, hilo)    # [N, ncb+2ch] u8
+    if packed is None:
+        packed, _ = pack_rows(X, grad, hess, included, hilo)  # [N, ncb+2ch] u8
+    ncb = F * cb
     if row_idx is not None:
         # pending-prefix gather, bounded to active chunks only — ONE random
         # row gather from the packed array per active row (vs four separate
