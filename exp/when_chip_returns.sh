@@ -18,8 +18,8 @@ while true; do
   sleep 120
 done
 
-echo "=== phase_a_check ==="
-timeout 2400 python -u exp/phase_a_check.py
 echo "=== bench (full scale, warm the cache) ==="
 LGBM_TPU_BENCH_TIMEOUT=2700 timeout 2900 python bench.py | tee exp/BENCH_local_r3.json
+echo "=== phase_a_check ==="
+timeout 2400 python -u exp/phase_a_check.py
 echo "$(date -u +%H:%M:%S) done"
