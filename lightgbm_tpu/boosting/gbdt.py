@@ -101,13 +101,14 @@ class GBDT:
         # row padding: per-device rows must be a chunk multiple
         Drow = self.pctx.pad_rows_multiple()
         per_target = max((N + Drow - 1) // Drow, 1)
-        # "auto" kernel: the Pallas VMEM-accumulator kernel on real TPU, the
-        # XLA one-hot matmul elsewhere (incl. the CPU test mesh — Pallas
-        # interpret mode is orders of magnitude slower there)
+        # "auto" kernel: the XLA one-hot matmul everywhere until the Pallas
+        # VMEM-accumulator kernel has passed its equality check on real
+        # hardware (this round's packed-u8/strided-unpack changes were only
+        # interpret-mode validated; Mosaic lowering can differ on libtpu).
+        # Opt in explicitly with tpu_hist_kernel=pallas.
         hist_kernel = config.tpu_hist_kernel
         if hist_kernel == "auto":
-            hist_kernel = ("pallas" if jax.default_backend()
-                           in ("tpu", "axon") else "xla")
+            hist_kernel = "xla"
             Log.debug("tpu_hist_kernel=auto resolved to %s", hist_kernel)
         chunk = min(config.tpu_hist_chunk, _round_up(per_target, 256))
         if hist_kernel == "pallas":

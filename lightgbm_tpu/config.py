@@ -286,9 +286,10 @@ class Config:
     # prefix-compacted index gather (the analog of the reference's
     # smaller-leaf histogramming, serial_tree_learner.cpp:354-362)
     tpu_row_compact: bool = True
-    # histogram kernel: "auto" (pallas on TPU, xla elsewhere) | "xla"
-    # one-hot matmul | "pallas" fused VMEM-accumulator kernel
-    # (ops/pallas_histogram.py, the OpenCL histogram256.cl analog)
+    # histogram kernel: "auto" (currently = xla until the pallas path is
+    # equality-checked on real hardware) | "xla" one-hot matmul | "pallas"
+    # fused VMEM-accumulator kernel (ops/pallas_histogram.py, the OpenCL
+    # histogram256.cl analog)
     tpu_hist_kernel: str = "auto"
     # per-phase wall-clock accumulators (reference TIMETAG) printed after
     # training; tpu_profile_dir wraps training in a jax.profiler trace

@@ -343,7 +343,10 @@ def grow_tree(
                     .astype(jnp.int32), axis=0)
                 return hist_pass(row_idx, n_active, counts)
 
-            new_hist = jax.lax.cond(n_active * 4 < N, compact_pass,
+            # N//4 is a static Python int, so the predicate cannot overflow
+            # int32 at any N — and it provably matches the pallas path's
+            # max_rows=(N+3)//4 buffer cap (n_active < N//4 <= (N+3)//4).
+            new_hist = jax.lax.cond(n_active < N // 4, compact_pass,
                                     lambda: hist_pass(None, None))
         else:
             new_hist = hist_pass(None, None)

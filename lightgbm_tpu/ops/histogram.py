@@ -70,7 +70,7 @@ def _split_hi_lo(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 # materializes at N x 512 B (5.4 GB at the 10.5M-row bench) while u8 pays
 # N x 128 B. Layout per row: F code bytes (2F little-endian for uint16
 # codes) then 2*ch bf16 weight bytes. Packing itself is a sequential O(N)
-# write, paid per wave.
+# write, paid once per tree (grow_tree builds it and passes packed=).
 
 def code_bytes(dtype) -> int:
     return 1 if dtype == jnp.uint8 else 2

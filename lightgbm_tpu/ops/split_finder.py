@@ -76,9 +76,17 @@ def leaf_split_gain(sum_g, sum_h, l1: float, l2: float):
 
 
 def leaf_output(sum_g, sum_h, l1: float, l2: float):
-    """-sign(g)(|g|-l1)_+ / (h+l2) — feature_histogram.hpp:304-310."""
+    """-sign(g)(|g|-l1)_+ / (h+l2) — feature_histogram.hpp:304-310.
+
+    A zero denominator (legal under min_sum_hessian_in_leaf=0, lambda_l2=0
+    with vanishing hessians) yields 0, not Inf: the score update resolves
+    leaf values through table_lookup's one-hot contraction, which touches
+    every table row, so a single Inf/NaN leaf would poison all rows.
+    """
     reg = jnp.maximum(jnp.abs(sum_g) - l1, 0.0)
-    return -jnp.sign(sum_g) * reg / (sum_h + l2)
+    denom = sum_h + l2
+    out = -jnp.sign(sum_g) * reg / denom
+    return jnp.where((denom > 0) & jnp.isfinite(out), out, 0.0)
 
 
 def per_feature_best_numerical(

@@ -261,11 +261,20 @@ class VotingParallelComm:
                 jnp.where(jnp.isfinite(top_gain), 1.0, 0.0))
         votes = jax.lax.psum(votes, self.axis)                      # GlobalVoting :165
 
-        # Phase 2 — reduce only the winning features' histograms. Tie-break by
-        # summed local gain so a feature strong on one shard beats a tie.
+        # Phase 2 — reduce only the winning features' histograms. Exact
+        # lexicographic (votes, summed local gain) order: each feature's gain
+        # is replaced by its ordinal rank within the slot (an integer < F),
+        # so votes*F + rank is exact integer arithmetic at ANY gain magnitude
+        # — the reference breaks ties via MaxK over weighted gains
+        # (voting_parallel_tree_learner.cpp:165-196); a sigmoid tie-break
+        # saturates for >1e2-scale gains and resolves arbitrarily.
         finite_gain = jnp.where(jnp.isfinite(local_gain), local_gain, 0.0)
-        rank_score = votes + 1e-6 * jax.nn.sigmoid(
-            jax.lax.psum(finite_gain, self.axis))
+        sum_gain = jax.lax.psum(finite_gain, self.axis)             # [S, F]
+        order = jnp.argsort(sum_gain, axis=1)                       # ascending
+        gain_rank = jnp.zeros((S, F), jnp.int32).at[
+            jnp.arange(S)[:, None], order].set(
+                jnp.arange(F, dtype=jnp.int32)[None, :])
+        rank_score = votes.astype(jnp.int32) * F + gain_rank
         _, sel = jax.lax.top_k(rank_score, k2)                      # [S, k2] global ids
         sel_hist = jnp.take_along_axis(
             hist, sel[:, :, None, None], axis=1)                    # [S, k2, B, 3]
