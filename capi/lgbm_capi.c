@@ -205,6 +205,59 @@ LGBM_EXPORT int LGBM_DatasetGetFeatureNames(DatasetHandle handle,
   END_CALL();
 }
 
+LGBM_EXPORT int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                                    int** sample_indices,
+                                                    int32_t ncol,
+                                                    const int* num_per_col,
+                                                    int32_t num_sample_row,
+                                                    int32_t num_total_row,
+                                                    const char* parameters,
+                                                    DatasetHandle* out_handle) {
+  BEGIN_CALL();
+  out = call_impl("dataset_create_from_sampled_column", "(LLiLiis)",
+                  (long long)(intptr_t)sample_data,
+                  (long long)(intptr_t)sample_indices, (int)ncol,
+                  (long long)(intptr_t)num_per_col, (int)num_sample_row,
+                  (int)num_total_row, parameters ? parameters : "");
+  if (out != NULL) *out_handle = (DatasetHandle)(intptr_t)as_i64(out);
+  END_CALL();
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                              int64_t num_total_row,
+                                              DatasetHandle* out_handle) {
+  BEGIN_CALL();
+  out = call_impl("dataset_create_by_reference", "(LL)",
+                  (long long)(intptr_t)reference, (long long)num_total_row);
+  if (out != NULL) *out_handle = (DatasetHandle)(intptr_t)as_i64(out);
+  END_CALL();
+}
+
+LGBM_EXPORT int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                                     int data_type, int32_t nrow, int32_t ncol,
+                                     int32_t start_row) {
+  BEGIN_CALL();
+  out = call_impl("dataset_push_rows", "(LLiiii)",
+                  (long long)(intptr_t)dataset, (long long)(intptr_t)data,
+                  data_type, (int)nrow, (int)ncol, (int)start_row);
+  END_CALL();
+}
+
+LGBM_EXPORT int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset,
+                                          const void* indptr, int indptr_type,
+                                          const int32_t* indices,
+                                          const void* data, int data_type,
+                                          int64_t nindptr, int64_t nelem,
+                                          int64_t num_col, int64_t start_row) {
+  BEGIN_CALL();
+  out = call_impl("dataset_push_rows_by_csr", "(LLiLLiLLLL)",
+                  (long long)(intptr_t)dataset, (long long)(intptr_t)indptr,
+                  indptr_type, (long long)(intptr_t)indices,
+                  (long long)(intptr_t)data, data_type, (long long)nindptr,
+                  (long long)nelem, (long long)num_col, (long long)start_row);
+  END_CALL();
+}
+
 LGBM_EXPORT int LGBM_DatasetFree(DatasetHandle handle) {
   BEGIN_CALL();
   out = call_impl("free_handle", "(L)", (long long)(intptr_t)handle);
@@ -369,6 +422,33 @@ LGBM_EXPORT int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
   BEGIN_CALL();
   out = call_impl("booster_rollback_one_iter", "(L)",
                   (long long)(intptr_t)handle);
+  END_CALL();
+}
+
+LGBM_EXPORT int LGBM_BoosterMerge(BoosterHandle handle,
+                                  BoosterHandle other_handle) {
+  BEGIN_CALL();
+  out = call_impl("booster_merge", "(LL)", (long long)(intptr_t)handle,
+                  (long long)(intptr_t)other_handle);
+  END_CALL();
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                                          int64_t* out_len) {
+  BEGIN_CALL();
+  out = call_impl("booster_get_num_predict", "(Li)",
+                  (long long)(intptr_t)handle, data_idx);
+  if (out != NULL) *out_len = as_i64(out);
+  END_CALL();
+}
+
+LGBM_EXPORT int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                                       int64_t* out_len, double* out_result) {
+  BEGIN_CALL();
+  out = call_impl("booster_get_predict", "(LiL)",
+                  (long long)(intptr_t)handle, data_idx,
+                  (long long)(intptr_t)out_result);
+  if (out != NULL) *out_len = as_i64(out);
   END_CALL();
 }
 

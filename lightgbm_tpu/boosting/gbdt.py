@@ -85,8 +85,6 @@ class GBDT:
         from ..parallel.comm import init_distributed
         init_distributed(config)
         self.objective = objective if objective is not None else create_objective(config)
-        if self.objective is not None:
-            self.objective.init(train_set.metadata, train_set.num_data)
         self.num_models = self.objective.num_models if self.objective else max(config.num_class, 1)
         K = self.num_models
 
@@ -94,13 +92,59 @@ class GBDT:
         #      application.cpp:167-178; tree_learner grid tree_learner.cpp:9) --
         self.pctx = make_parallel_context(config)
 
+        # ---- pre-partitioned data (reference dataset_loader.cpp:159-221 +
+        #      Metadata::CheckOrPartition): under is_pre_partition each
+        #      process loaded ONLY its own row shard, so the global row space
+        #      is assembled as equal per-process blocks — the feature matrix
+        #      stays process-local (the memory that matters at scale) while
+        #      the cheap metadata (4-8 B/row) is gathered host-side so
+        #      boost-from-average / objectives / metrics see global stats. --
         N = train_set.num_data
+        meta_global = train_set.metadata
+        self._block_counts: Optional[List[int]] = None
+        if (config.is_pre_partition and self.pctx.multi_process
+                and self.pctx.strategy in ("data", "voting")):
+            from ..parallel.comm import host_allgather
+            md = train_set.metadata
+            if md.init_score is not None:
+                Log.fatal("is_pre_partition does not support init_score")
+            if md.query_boundaries is not None:
+                Log.fatal("is_pre_partition does not support query/group "
+                          "data (queries cannot span row shards)")
+            blocks = host_allgather(
+                dict(n=int(N), label=np.asarray(md.label, np.float32),
+                     weight=None if md.weight is None
+                     else np.asarray(md.weight, np.float32)),
+                "pre_partition_meta")
+            self._block_counts = [int(b["n"]) for b in blocks]
+            N = int(sum(self._block_counts))
+            meta_global = Metadata(N)
+            meta_global.set_label(np.concatenate([b["label"] for b in blocks]))
+            n_weighted = sum(b["weight"] is not None for b in blocks)
+            if n_weighted == len(blocks):
+                meta_global.set_weight(
+                    np.concatenate([b["weight"] for b in blocks]))
+            elif n_weighted:
+                Log.fatal("is_pre_partition: %d of %d shards have weights — "
+                          "every shard must provide them or none",
+                          n_weighted, len(blocks))
+            Log.info("pre-partitioned data: %d rows across %d processes %s",
+                     N, len(blocks), self._block_counts)
+        self._meta_global = meta_global
+
+        if self.objective is not None:
+            self.objective.init(meta_global, N)
+
         F = train_set.num_features
         # feature padding: block-partitioned strategies need F % devices == 0
         F_pad = self.pctx.pad_features_to(max(F, 1))
-        # row padding: per-device rows must be a chunk multiple
+        # row padding: per-device rows must be a chunk multiple; equal
+        # per-process blocks under pre-partition (the largest shard sizes
+        # every block so local data always fits its block)
         Drow = self.pctx.pad_rows_multiple()
-        per_target = max((N + Drow - 1) // Drow, 1)
+        n_for_pad = N if self._block_counts is None else \
+            max(self._block_counts) * len(self._block_counts)
+        per_target = max((n_for_pad + Drow - 1) // Drow, 1)
         # "auto" kernel: the XLA one-hot matmul everywhere until the Pallas
         # VMEM-accumulator kernel has passed its equality check on real
         # hardware (this round's packed-u8/strided-unpack changes were only
@@ -126,11 +170,19 @@ class GBDT:
 
         # ---- EFB bundling (reference Dataset::Construct enable_bundle path,
         #      dataset.cpp:236-247): pack near-exclusive features into fewer
-        #      histogram columns. Serial strategy only — distributed feature
-        #      blocking would need equal-width bundled blocks per device. ----
+        #      histogram columns. Works for serial AND the row-sharded
+        #      strategies (data/voting — the plan is deterministic and every
+        #      process holds the full matrix, so all ranks agree; the grower
+        #      unpacks to original feature space before the collective, see
+        #      grower.py). Excluded: feature-parallel (columns are already
+        #      block-partitioned, bundling would break the equal blocks) and
+        #      pre-partitioned data (each process would plan from a different
+        #      local shard). ----
         self.bundle = None
         bundle_plan = None
-        if (config.enable_bundle and self.pctx.strategy == "serial" and F >= 2):
+        if (config.enable_bundle and F >= 2
+                and self.pctx.strategy in ("serial", "data", "voting")
+                and self._block_counts is None):
             from ..efb import plan_bundles
             plan = plan_bundles(train_set.X_binned,
                                 meta["num_bins"].astype(np.int64),
@@ -162,12 +214,20 @@ class GBDT:
             self._hist_bins = Bb_pad
         else:
             Xb = train_set.X_binned
-            self.Xb = self._put(np.pad(Xb, ((0, Npad - N), (0, F_pad - F))), "rows0")
+            if self._block_counts is not None:
+                bp = Npad // len(self._block_counts)
+                local = np.pad(Xb, ((0, bp - Xb.shape[0]), (0, F_pad - F)))
+                self.Xb = self._put_rows0_local(local, Npad)
+            else:
+                self.Xb = self._put(
+                    np.pad(Xb, ((0, Npad - N), (0, F_pad - F))), "rows0")
             self._hist_bins = 0
-        self.label = self._put(np.pad(train_set.metadata.label, (0, Npad - N)), "rows")
-        w = train_set.metadata.weight
-        self.weight = None if w is None else self._put(np.pad(w, (0, Npad - N)), "rows")
-        self.pad_mask = self._put((np.arange(Npad) < N).astype(np.float32), "rows")
+        self.label = self._put(self._row_layout(meta_global.label, Npad), "rows")
+        w = meta_global.weight
+        self.weight = None if w is None else self._put(
+            self._row_layout(w, Npad), "rows")
+        self.pad_mask = self._put(
+            self._row_layout(np.ones(N, np.float32), Npad), "rows")
 
         fpad = F_pad - F
         self.num_bins = self._put(np.pad(meta["num_bins"], (0, fpad), constant_values=1))
@@ -215,7 +275,7 @@ class GBDT:
 
         self.train_metrics = create_metrics(config, self.objective.name if self.objective else None)
         for m in self.train_metrics:
-            m.init(train_set.metadata, N)
+            m.init(meta_global, N)
         self.valid_sets: List[ValidSet] = []
 
         # ---- initial scores -------------------------------------------------
@@ -251,6 +311,48 @@ class GBDT:
         self._custom_step_fn = None
 
     # ------------------------------------------------------------------ setup
+
+    def _real_rows(self):
+        """Index of real (non-padding) rows in the padded device layout, in
+        global row order — a plain slice normally, the per-process block
+        positions under pre-partition (where [:N] would pick block-0 padding
+        and drop block-1's tail)."""
+        if self._block_counts is None:
+            return slice(0, self.num_data)
+        bp = self.num_data_padded // len(self._block_counts)
+        return np.concatenate([np.arange(c) + p * bp
+                               for p, c in enumerate(self._block_counts)])
+
+    def _row_layout(self, arr, npad: Optional[int] = None, fill=0):
+        """Host row array (global row order) -> padded device layout.
+
+        Normally: data first, padding at the tail. Under pre-partition: equal
+        per-process blocks of Npad/P rows, each process's rows at the head of
+        its block — matching `_put_rows0_local`'s placement of the local
+        feature matrix, so row i of the label/mask lines up with row i of X.
+        """
+        arr = np.asarray(arr)
+        npad = self.num_data_padded if npad is None else npad
+        out = np.full((npad,) + arr.shape[1:], fill, arr.dtype)
+        if self._block_counts is None:
+            out[: arr.shape[0]] = arr
+        else:
+            bp = npad // len(self._block_counts)
+            off = 0
+            for p, c in enumerate(self._block_counts):
+                out[p * bp: p * bp + c] = arr[off: off + c]
+                off += c
+        return out
+
+    def _put_rows0_local(self, local_block: np.ndarray, npad: int):
+        """Assemble the global row-sharded [Npad, F] array from this
+        process's padded block — no process ever holds the others' features
+        (jax.make_array_from_process_local_data; the reference's
+        pre-partitioned load keeps shards local the same way)."""
+        pctx = self.pctx
+        sharding = NamedSharding(pctx.mesh, P(pctx.ROW_AXIS, None))
+        return jax.make_array_from_process_local_data(
+            sharding, local_block, (npad, local_block.shape[1]))
 
     def _put(self, x, kind: str = "repl"):
         """Place an array on this booster's device(s).
@@ -342,7 +444,8 @@ class GBDT:
         K = self.num_models
         comm = self.comm
 
-        bundle = self.bundle              # EFB is serial-only: never sharded
+        bundle = self.bundle              # EFB: serial + data/voting (grower
+                                          # unpacks before the collective)
 
         def grow_fn(X, g, h, inc, fok, iscat, nb, mc, db):
             return grow_tree(X, g, h, inc, fok, iscat, nb, mc, db, spec, comm,
@@ -459,6 +562,10 @@ class GBDT:
         LGBM_BoosterUpdateOneIterCustom, c_api.cpp:892): fobj(preds, dataset)
         -> (grad, hess) as numpy [K*N] in class-major order."""
         K, Npad, N = self.num_models, self.num_data_padded, self.num_data
+        if self._block_counts is not None:
+            Log.fatal("custom objectives are not supported with "
+                      "is_pre_partition (host gradients need the full score "
+                      "vector on every process)")
         preds = self._fetch(self.score)[:, :N].reshape(-1)
         grad, hess = fobj(preds, self.train_set)
         g = np.zeros((K, Npad), np.float32)
@@ -627,7 +734,7 @@ class GBDT:
             eval_dataset(
                 "training", self.train_metrics, self.score, self.label,
                 self.weight, self.pad_mask,
-                lambda: self._fetch(self._convert(self.score))[:, : self.num_data])
+                lambda: self._fetch(self._convert(self.score))[:, self._real_rows()])
         for vs in self.valid_sets:
             if not hasattr(vs, "label_dev"):
                 vs.label_dev = self._put(

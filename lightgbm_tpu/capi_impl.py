@@ -14,7 +14,9 @@ LGBM_GetLastError() carrying the message.
 from __future__ import annotations
 
 import ctypes
+import functools
 import json
+import threading
 from typing import Dict, Optional
 
 import numpy as np
@@ -23,24 +25,49 @@ from .basic import Booster, Dataset
 from .config import resolve_aliases
 
 # ---- handle registry -------------------------------------------------------
+# The registry itself and each handle's object are mutex-guarded like the
+# reference (c_api.cpp:29 Booster lock, :67 handle lifetime): the embedded-C
+# hosting mode may call in from multiple native threads, and jax/numpy
+# release the GIL mid-operation.
 
 _objects: Dict[int, object] = {}
 _next_handle = [1]
+_registry_lock = threading.RLock()
+_handle_locks: Dict[int, threading.RLock] = {}
 
 
 def _register(obj) -> int:
-    h = _next_handle[0]
-    _next_handle[0] += 1
-    _objects[h] = obj
-    return h
+    with _registry_lock:
+        h = _next_handle[0]
+        _next_handle[0] += 1
+        _objects[h] = obj
+        _handle_locks[h] = threading.RLock()
+        return h
 
 
 def _get(h: int):
-    return _objects[int(h)]
+    with _registry_lock:
+        return _objects[int(h)]
+
+
+def _lock_of(h: int) -> threading.RLock:
+    with _registry_lock:
+        return _handle_locks.setdefault(int(h), threading.RLock())
+
+
+def _with_handle_lock(fn):
+    """Serialize operations on one handle (first argument)."""
+    @functools.wraps(fn)
+    def wrapper(handle, *args, **kwargs):
+        with _lock_of(handle):
+            return fn(handle, *args, **kwargs)
+    return wrapper
 
 
 def free_handle(h: int) -> None:
-    _objects.pop(int(h), None)
+    with _registry_lock:
+        _objects.pop(int(h), None)
+        _handle_locks.pop(int(h), None)
 
 
 # ---- raw-memory views ------------------------------------------------------
@@ -142,6 +169,189 @@ def dataset_create_from_csc(colptr_ptr: int, colptr_type: int,
     return _register(ds)
 
 
+class _StreamingDataset:
+    """Chunk-streamed dataset creation (reference c_api.h:67-127:
+    LGBM_DatasetCreateFromSampledColumn / CreateByReference + PushRows[ByCSR]).
+
+    TPU-first inversion of the reference's push path: BinMappers are built
+    up-front (from the provided column sample, or borrowed from the reference
+    dataset), and every pushed chunk is binned to uint8/16 codes immediately —
+    the float matrix never materializes, so ingestion is genuinely
+    out-of-core like the reference's PushData → FinishLoad flow."""
+
+    def __init__(self, features, num_total_features, feature_names, config,
+                 params, num_total_row: int, ref_basic: Optional[Dataset]):
+        self.features = features                    # List[FeatureInfo]
+        self.num_total_features = num_total_features
+        self.feature_names = feature_names
+        self.config = config
+        self.params = params
+        self.num_total_row = int(num_total_row)
+        self.ref_basic = ref_basic
+        dtype = np.uint8 if all(f.mapper.num_bin <= 256 for f in features) \
+            else np.uint16
+        self.X_binned = np.zeros((self.num_total_row, max(len(features), 1)),
+                                 dtype=dtype)
+        self.fields: Dict[str, np.ndarray] = {}
+
+    @classmethod
+    def from_reference(cls, ref_basic: Dataset, num_total_row: int,
+                       params: dict) -> "_StreamingDataset":
+        from .dataset import FeatureInfo
+        ref_basic.construct()
+        cd = ref_basic._constructed
+        if cd is None:
+            raise ValueError("reference dataset has no constructed bin "
+                             "mappers (is it itself an aligned valid set?)")
+        features = [FeatureInfo(int(r), m)
+                    for r, m in zip(cd.real_feature_idx, cd.mappers)]
+        return cls(features, cd.num_total_features, cd.feature_names,
+                   cd.config, params, num_total_row, ref_basic)
+
+    @classmethod
+    def from_samples(cls, samples, num_sample_row: int, num_total_row: int,
+                     params: dict) -> "_StreamingDataset":
+        """``samples[j]``: sampled NON-ZERO values of column j (zeros implied
+        by num_sample_row — the BinMapper::FindBin contract, bin.cpp:232)."""
+        from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
+        from .config import Config
+        from .dataset import FeatureInfo, _parse_column_spec
+        config = Config.from_params(params)
+        ncol = len(samples)
+        feature_names = [f"Column_{i}" for i in range(ncol)]
+        cat_set = set(_parse_column_spec(config.categorical_column,
+                                         feature_names))
+        filter_cnt = int(config.min_data_in_leaf * num_sample_row
+                         / max(num_total_row, 1))
+        features = []
+        for j in range(ncol):
+            mapper = BinMapper()
+            mapper.find_bin(
+                np.asarray(samples[j], dtype=np.float64), num_sample_row,
+                config.max_bin, config.min_data_in_bin, filter_cnt,
+                BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL,
+                config.use_missing, config.zero_as_missing)
+            if not mapper.is_trivial:
+                features.append(FeatureInfo(j, mapper))
+        return cls(features, ncol, feature_names, config, params,
+                   num_total_row, None)
+
+    def push_dense(self, chunk: np.ndarray, start_row: int) -> bool:
+        n = chunk.shape[0]
+        if start_row + n > self.num_total_row:
+            raise ValueError(f"push beyond num_total_row: {start_row}+{n} > "
+                             f"{self.num_total_row}")
+        dt = self.X_binned.dtype
+        for inner, f in enumerate(self.features):
+            self.X_binned[start_row:start_row + n, inner] = \
+                f.mapper.value_to_bin(chunk[:, f.real_index]).astype(dt)
+        # reference: FinishLoad when nrow + start_row == num_total_row
+        return start_row + n == self.num_total_row
+
+    # buffered metadata: the reference allows SetField before FinishLoad
+    def set_label(self, v):
+        self.fields["label"] = v
+
+    def set_weight(self, v):
+        self.fields["weight"] = v
+
+    def set_group(self, v):
+        self.fields["group"] = v
+
+    def set_init_score(self, v):
+        self.fields["init_score"] = v
+
+    def num_data(self) -> int:
+        return self.num_total_row
+
+    def num_feature(self) -> int:
+        return self.num_total_features
+
+    def finish(self) -> Dataset:
+        """Materialize the real Dataset; the caller swaps it into the
+        registry under the same handle (the C side's pointer is unchanged)."""
+        from .dataset import ConstructedDataset, Metadata
+        meta = Metadata(self.num_total_row)
+        if "label" in self.fields:
+            meta.set_label(self.fields["label"])
+        if "weight" in self.fields:
+            meta.set_weight(self.fields["weight"])
+        if "group" in self.fields:
+            meta.set_group(self.fields["group"])
+        if "init_score" in self.fields:
+            meta.set_init_score(self.fields["init_score"])
+        cd = ConstructedDataset(self.X_binned, self.features,
+                                self.num_total_features, meta,
+                                self.feature_names, self.config)
+        d = Dataset(np.zeros((0, 1)), params=dict(self.params))
+        d._constructed = cd
+        # mirror buffered fields onto the Dataset attributes too, so
+        # LGBM_DatasetGetField sees what was SetField'd before the last push
+        d.label = meta.label
+        d.weight = self.fields.get("weight")
+        d.group = self.fields.get("group")
+        d.init_score = self.fields.get("init_score")
+        if self.ref_basic is not None:
+            # usable as an aligned valid set too (Booster.add_valid contract)
+            d.reference = self.ref_basic
+            d._binned_aligned = self.X_binned
+            d._metadata = meta
+        return d
+
+
+def dataset_create_by_reference(reference: int, num_total_row: int) -> int:
+    with _lock_of(reference):            # from_reference constructs the ref
+        stream = _StreamingDataset.from_reference(_get(reference),
+                                                  int(num_total_row), {})
+    return _register(stream)
+
+
+def dataset_create_from_sampled_column(col_ptrs_addr: int, ind_ptrs_addr: int,
+                                       ncol: int, num_per_col_ptr: int,
+                                       num_sample_row: int,
+                                       num_total_row: int,
+                                       parameters: str) -> int:
+    npc = np.array(_view(num_per_col_ptr, 2, ncol))
+    col_ptrs = (ctypes.c_void_p * int(ncol)).from_address(int(col_ptrs_addr))
+    samples = [np.array(_view(col_ptrs[j], 1, int(npc[j])))
+               if npc[j] else np.zeros(0) for j in range(int(ncol))]
+    stream = _StreamingDataset.from_samples(samples, int(num_sample_row),
+                                            int(num_total_row),
+                                            _params(parameters))
+    return _register(stream)
+
+
+def _finish_stream(handle: int, stream: _StreamingDataset) -> None:
+    with _registry_lock:
+        _objects[int(handle)] = stream.finish()
+
+
+@_with_handle_lock
+def dataset_push_rows(handle: int, data_ptr: int, data_type: int, nrow: int,
+                      ncol: int, start_row: int) -> None:
+    stream: _StreamingDataset = _get(handle)
+    chunk = np.array(_view(data_ptr, data_type, nrow * ncol),
+                     dtype=np.float64).reshape(nrow, ncol)
+    if stream.push_dense(chunk, int(start_row)):
+        _finish_stream(handle, stream)
+
+
+@_with_handle_lock
+def dataset_push_rows_by_csr(handle: int, indptr_ptr: int, indptr_type: int,
+                             indices_ptr: int, data_ptr: int, data_type: int,
+                             nindptr: int, nelem: int, num_col: int,
+                             start_row: int) -> None:
+    import scipy.sparse as sp
+    stream: _StreamingDataset = _get(handle)
+    indptr = np.array(_view(indptr_ptr, indptr_type, nindptr), dtype=np.int64)
+    indices = np.array(_view(indices_ptr, 2, nelem))
+    data = np.array(_view(data_ptr, data_type, nelem), dtype=np.float64)
+    chunk = sp.csr_matrix((data, indices, indptr),
+                          shape=(int(nindptr) - 1, int(num_col))).toarray()
+    if stream.push_dense(chunk, int(start_row)):
+        _finish_stream(handle, stream)
+
+
 def dataset_get_subset(handle: int, indices_ptr: int, num_indices: int,
                        parameters: str) -> int:
     ds: Dataset = _get(handle)
@@ -160,12 +370,14 @@ def dataset_get_feature_names(handle: int, ptrs_addr: int) -> int:
     return _write_string_array(ptrs_addr, names)
 
 
+@_with_handle_lock
 def dataset_save_binary(handle: int, filename: str) -> None:
     ds: Dataset = _get(handle)
     ds.construct()
     ds._constructed.save_binary(filename)
 
 
+@_with_handle_lock
 def dataset_set_field(handle: int, field: str, ptr: int, n: int,
                       dtype_code: int) -> None:
     ds: Dataset = _get(handle)
@@ -182,6 +394,7 @@ def dataset_set_field(handle: int, field: str, ptr: int, n: int,
         raise ValueError(f"unknown field {field}")
 
 
+@_with_handle_lock
 def dataset_get_field(handle: int, field: str, out_ptr_addr: int,
                       out_type_addr: int) -> int:
     """Returns length; writes the array pointer + dtype code like
@@ -216,7 +429,9 @@ def dataset_get_num_feature(handle: int) -> int:
 # ---- booster ---------------------------------------------------------------
 
 def booster_create(train_handle: int, parameters: str) -> int:
-    bst = Booster(params=_params(parameters), train_set=_get(train_handle))
+    with _lock_of(train_handle):         # construction mutates the dataset
+        bst = Booster(params=_params(parameters),
+                      train_set=_get(train_handle))
     return _register(bst)
 
 
@@ -229,13 +444,18 @@ def booster_load_from_string(model_str: str) -> int:
 
 
 def booster_add_valid_data(handle: int, valid_handle: int) -> None:
-    bst: Booster = _get(handle)
-    vs: Dataset = _get(valid_handle)
-    if vs.reference is None:
-        vs.reference = bst.train_dataset
-    bst.add_valid(vs, f"valid_{len(getattr(bst._gbdt, 'valid_sets', []))}")
+    # two locks in handle order (same protocol as booster_merge): add_valid
+    # constructs/aligns the valid dataset, which mutates it
+    h1, h2 = sorted((int(handle), int(valid_handle)))
+    with _lock_of(h1), _lock_of(h2):
+        bst: Booster = _get(handle)
+        vs: Dataset = _get(valid_handle)
+        if vs.reference is None:
+            vs.reference = bst.train_dataset
+        bst.add_valid(vs, f"valid_{len(getattr(bst._gbdt, 'valid_sets', []))}")
 
 
+@_with_handle_lock
 def booster_reset_training_data(handle: int, train_handle: int) -> None:
     bst: Booster = _get(handle)
     # update(train_set=...) swaps the data AND trains one iteration;
@@ -245,6 +465,7 @@ def booster_reset_training_data(handle: int, train_handle: int) -> None:
     bst.rollback_one_iter()
 
 
+@_with_handle_lock
 def booster_reset_parameter(handle: int, parameters: str) -> None:
     _get(handle).reset_parameter(_params(parameters))
 
@@ -253,6 +474,7 @@ def booster_get_num_classes(handle: int) -> int:
     return max(int(_get(handle).params.get("num_class", 1)), 1)
 
 
+@_with_handle_lock
 def booster_update_one_iter(handle: int) -> int:
     bst: Booster = _get(handle)
     before = bst._gbdt.iter_
@@ -268,6 +490,7 @@ def dataset_get_num_data_of_booster(handle: int) -> int:
                * max(bst.num_model_per_iteration, 1))
 
 
+@_with_handle_lock
 def booster_update_one_iter_custom(handle: int, grad_ptr: int, hess_ptr: int,
                                    n: int) -> int:
     bst: Booster = _get(handle)
@@ -277,8 +500,60 @@ def booster_update_one_iter_custom(handle: int, grad_ptr: int, hess_ptr: int,
     return 0
 
 
+@_with_handle_lock
 def booster_rollback_one_iter(handle: int) -> None:
     _get(handle).rollback_one_iter()
+
+
+def booster_merge(handle: int, other_handle: int) -> None:
+    """LGBM_BoosterMerge (c_api.h:361): append other's trees to handle's
+    forest. Device training state of the target is released (resume by
+    passing a train_set to the next update, the continued-training path);
+    the merged model predicts/saves immediately — the reference's
+    worker-train-then-merge usage."""
+    import copy
+    h1, h2 = sorted((int(handle), int(other_handle)))
+    with _lock_of(h1), _lock_of(h2):
+        bst: Booster = _sync(_get(handle))
+        other: Booster = _sync(_get(other_handle))
+        if max(bst.num_model_per_iteration, 1) != \
+                max(other.num_model_per_iteration, 1):
+            raise ValueError("cannot merge boosters with different "
+                             "models-per-iteration")
+        if bst._gbdt is not None:
+            bst.free_dataset()
+        bst.trees = list(bst.trees) + [copy.deepcopy(t) for t in other.trees]
+        bst._stacked_cache = None
+
+
+@_with_handle_lock
+def booster_get_num_predict(handle: int, data_idx: int) -> int:
+    """LGBM_BoosterGetNumPredict (c_api.h:488): score length for the
+    training data (0) or i-th valid set (i+1)."""
+    gbdt = _get(handle)._gbdt
+    if gbdt is None:
+        raise ValueError("booster has no training data attached")
+    if int(data_idx) == 0:
+        n = gbdt.num_data
+    else:
+        n = gbdt.valid_sets[int(data_idx) - 1].num_data
+    return int(n) * max(gbdt.num_models, 1)
+
+
+@_with_handle_lock
+def booster_get_predict(handle: int, data_idx: int, out_ptr: int) -> int:
+    """LGBM_BoosterGetPredict (c_api.h:502): current objective-transformed
+    scores of train/valid rows, class-major like GBDT::GetPredictAt
+    (gbdt.cpp:683-708)."""
+    gbdt = _get(handle)._gbdt
+    if gbdt is None:
+        raise ValueError("booster has no training data attached")
+    if int(data_idx) == 0:
+        scores = gbdt._fetch(gbdt._convert(gbdt.score))[:, : gbdt.num_data]
+    else:
+        vs = gbdt.valid_sets[int(data_idx) - 1]
+        scores = gbdt._fetch(gbdt._convert(vs.score))[:, : vs.num_data]
+    return _write_doubles(out_ptr, np.asarray(scores, np.float64).reshape(-1))
 
 
 def _sync(bst: Booster) -> Booster:
@@ -320,6 +595,7 @@ def booster_get_eval_names(handle: int, ptrs_addr: int) -> int:
     return _write_string_array(ptrs_addr, _metric_names(_get(handle)))
 
 
+@_with_handle_lock
 def booster_get_eval(handle: int, data_idx: int, out_ptr: int) -> int:
     """data_idx 0 = training, i+1 = i-th valid set (c_api.h:474)."""
     bst: Booster = _get(handle)
@@ -341,6 +617,7 @@ def booster_get_num_feature(handle: int) -> int:
     return int(_get(handle).num_total_features)
 
 
+@_with_handle_lock
 def booster_calc_num_predict(handle: int, num_row: int, predict_type: int,
                              num_iteration: int) -> int:
     bst: Booster = _sync(_get(handle))
@@ -368,6 +645,7 @@ def _predict(bst: Booster, X, predict_type: int, num_iteration: int,
     return _write_doubles(out_ptr, np.asarray(preds, np.float64))
 
 
+@_with_handle_lock
 def booster_predict_for_mat(handle: int, data_ptr: int, data_type: int,
                             nrow: int, ncol: int, is_row_major: int,
                             predict_type: int, num_iteration: int,
@@ -378,6 +656,7 @@ def booster_predict_for_mat(handle: int, data_ptr: int, data_type: int,
                     num_iteration, parameter, out_ptr)
 
 
+@_with_handle_lock
 def booster_predict_for_csr(handle: int, indptr_ptr: int, indptr_type: int,
                             indices_ptr: int, data_ptr: int, data_type: int,
                             nindptr: int, nelem: int, num_col: int,
@@ -393,6 +672,7 @@ def booster_predict_for_csr(handle: int, indptr_ptr: int, indptr_type: int,
                     parameter, out_ptr)
 
 
+@_with_handle_lock
 def booster_predict_for_csc(handle: int, colptr_ptr: int, colptr_type: int,
                             indices_ptr: int, data_ptr: int, data_type: int,
                             ncolptr: int, nelem: int, num_row: int,
@@ -408,6 +688,7 @@ def booster_predict_for_csc(handle: int, colptr_ptr: int, colptr_type: int,
                     parameter, out_ptr)
 
 
+@_with_handle_lock
 def booster_predict_for_file(handle: int, data_filename: str,
                              data_has_header: int, predict_type: int,
                              num_iteration: int, parameter: str,
@@ -428,11 +709,13 @@ def booster_predict_for_file(handle: int, data_filename: str,
             fh.write("\t".join(f"{v:.18g}" for v in np.atleast_1d(row)) + "\n")
 
 
+@_with_handle_lock
 def booster_save_model(handle: int, num_iteration: int, filename: str) -> None:
     _sync(_get(handle)).save_model(filename,
                             num_iteration if num_iteration > 0 else None)
 
 
+@_with_handle_lock
 def booster_save_model_to_string(handle: int, num_iteration: int,
                                  buffer_len: int, out_ptr: int) -> int:
     text = _sync(_get(handle)).model_to_string(
@@ -440,16 +723,19 @@ def booster_save_model_to_string(handle: int, num_iteration: int,
     return _write_string(out_ptr, text, buffer_len)
 
 
+@_with_handle_lock
 def booster_dump_model(handle: int, num_iteration: int, buffer_len: int,
                        out_ptr: int) -> int:
     d = _sync(_get(handle)).dump_model(num_iteration if num_iteration > 0 else None)
     return _write_string(out_ptr, json.dumps(d), buffer_len)
 
 
+@_with_handle_lock
 def booster_get_leaf_value(handle: int, tree_idx: int, leaf_idx: int) -> float:
     return float(_sync(_get(handle)).trees[int(tree_idx)].leaf_value[int(leaf_idx)])
 
 
+@_with_handle_lock
 def booster_set_leaf_value(handle: int, tree_idx: int, leaf_idx: int,
                            val: float) -> None:
     bst: Booster = _sync(_get(handle))
@@ -457,6 +743,7 @@ def booster_set_leaf_value(handle: int, tree_idx: int, leaf_idx: int,
     bst._stacked_cache = None        # device predict caches copy leaf values
 
 
+@_with_handle_lock
 def booster_feature_importance(handle: int, num_iteration: int,
                                importance_type: int, out_ptr: int) -> int:
     imp = _sync(_get(handle)).feature_importance(

@@ -252,8 +252,23 @@ def grow_tree(
     # leaves each device only its F/D feature block (reference
     # data_parallel_tree_learner.cpp:148-163) — the per-leaf cache, sibling
     # subtraction, and split scan all live in that post-reduction space.
-    F_cache = comm.reduced_hist_features(F_hist)
-    B_hist = spec.hist_bins or B  # bundle-space bin axis
+    #
+    # Distributed EFB: the histogram BUILD runs in bundle space ([G, Bb]
+    # one-hot matmul columns — the compute win), but the collective and
+    # everything after it run in ORIGINAL feature space: bundled histograms
+    # are unpacked locally right before comm.reduce_hist using this shard's
+    # leaf totals, so feature blocks stay contiguous and the downstream scan
+    # is unchanged. (Bundle-space reduction would hand each device a block
+    # of bundles whose member features are non-contiguous.)
+    unbundle_early = (bundle is not None
+                      and getattr(comm, "axis", None) is not None)
+    B_hist = spec.hist_bins or B  # bundle-space bin axis (build side)
+    if unbundle_early:
+        F_cache = comm.reduced_hist_features(spec.num_features)
+        B_cache = B
+    else:
+        F_cache = comm.reduced_hist_features(F_hist)
+        B_cache = B_hist
     bm = comm.block_meta(feature_ok, num_bins, missing_code, default_bin, is_cat)
 
     rg, rh, rc = comm.reduce_scalars(*root_sums(grad, hess, included))
@@ -273,7 +288,7 @@ def grow_tree(
     state = GrowState(
         tree=tree,
         leaf_id=jnp.zeros(N, jnp.int32),
-        hist=jnp.zeros((L + 1, F_cache, B_hist, 3), jnp.float32),
+        hist=jnp.zeros((L + 1, F_cache, B_cache, 3), jnp.float32),
         sum_g=jnp.zeros(L + 1, jnp.float32).at[0].set(rg),
         sum_h=jnp.zeros(L + 1, jnp.float32).at[0].set(rh),
         cnt=jnp.zeros(L + 1, jnp.float32).at[0].set(rc),
@@ -350,6 +365,17 @@ def grow_tree(
                                     lambda: hist_pass(None, None))
         else:
             new_hist = hist_pass(None, None)
+        if unbundle_early:
+            # this shard's leaf totals: any bundled column's bins partition
+            # the shard's included rows, so column 0's bin sums ARE them —
+            # exactly what _unpack_bundled's FixHistogram-by-subtraction
+            # needs for LOCAL histograms (global totals would mis-size the
+            # reconstructed default bin before the psum)
+            lpg = jnp.sum(new_hist[:, 0, :, 0], axis=-1)
+            lph = jnp.sum(new_hist[:, 0, :, 1], axis=-1)
+            lpc = jnp.sum(new_hist[:, 0, :, 2], axis=-1)
+            new_hist = _unpack_bundled(new_hist, bundle, lpg, lph, lpc,
+                                       default_bin)
         new_hist = comm.reduce_hist(new_hist)
 
         # ---- 3. cache write + sibling by subtraction -----------------------
@@ -365,7 +391,7 @@ def grow_tree(
         # ---- 4. split scan for the 2S touched leaves -----------------------
         scan_leaves = jnp.concatenate([leaf_of_slot, jnp.where(slot_valid, sibs, L)])
         scan_hist = jnp.concatenate([new_hist, sib_hist], axis=0)  # [2S, F, B, 3]
-        if bundle is not None:
+        if bundle is not None and not unbundle_early:
             scan_hist = _unpack_bundled(
                 scan_hist, bundle, state.sum_g[scan_leaves],
                 state.sum_h[scan_leaves], state.cnt[scan_leaves], default_bin)

@@ -413,6 +413,41 @@ def _local_rank(machines, local_listen_port: int) -> int:
         f"{local_listen_port}")
 
 
+_host_allgather_seq = [0]
+
+
+def host_allgather(obj, tag: str, timeout_ms: int = 600_000) -> list:
+    """Gather one picklable object per process, returned rank-ordered.
+
+    Host-side analog of the reference's Network::Allgather for setup-time
+    payloads (serialized BinMappers, dataset_loader.cpp:889; row counts for
+    pre-partitioned data, dataset_loader.cpp:159-221) — exchanged through
+    jax's coordination-service KV store, not a hand-built TCP mesh. The call
+    sequence must be identical on every process (SPMD), which makes the
+    per-tag sequence number agree."""
+    import pickle
+
+    client = distributed_client()
+    if client is None or jax.process_count() <= 1:
+        return [obj]
+    rank, world = jax.process_index(), jax.process_count()
+    seq = _host_allgather_seq[0]
+    _host_allgather_seq[0] += 1
+    key = f"lgbm_hostgather/{tag}/{seq}"
+    client.key_value_set_bytes(f"{key}/{rank}", pickle.dumps(obj))
+    out = []
+    for r in range(world):
+        out.append(obj if r == rank else pickle.loads(
+            client.blocking_key_value_get_bytes(f"{key}/{r}", timeout_ms)))
+    try:
+        # every rank must have READ every shard before any key disappears
+        client.wait_at_barrier(f"{key}/done", timeout_ms)
+        client.key_value_delete(f"{key}/{rank}")
+    except Exception:
+        pass                         # best-effort server-side cleanup
+    return out
+
+
 def distributed_client():
     """The jax coordination-service client, or None when not running under
     jax.distributed (single probe point for the private-API access)."""

@@ -1,20 +1,34 @@
-"""Child process for the multi-host test: trains data-parallel over a
+"""Child process for the multi-host tests: trains data-parallel over a
 2-process jax.distributed CPU cluster wired through the reference's network
 params (machines + local_listen_port + num_machines) and writes the model
 from rank 0.
 
-Usage: python multihost_child.py <rank> <port0> <port1> <out_model>
+Modes (reference dataset_loader.cpp:159-221):
+- full:    every process loads the full data (the non-pre-partitioned path;
+           jax shards rows across the mesh)
+- prepart: is_pre_partition=true — each process loads ONLY its own row
+           shard; global rows are assembled as per-process blocks
+
+Usage: python multihost_child.py <rank> <port0> <port1> <out_model> [mode]
 """
 import sys
 
 rank, port0, port1, out_model = (int(sys.argv[1]), int(sys.argv[2]),
                                  int(sys.argv[3]), sys.argv[4])
+mode = sys.argv[5] if len(sys.argv) > 5 else "full"
 
 import numpy as np
 import lightgbm_tpu as lgb
 
 rng = np.random.RandomState(7)
-X = rng.rand(4000, 10)
+if mode == "prepart":
+    # discrete feature values: every shard sees the same distinct set, so
+    # distributed bin finding (feature-sharded, local-sample) produces the
+    # same mappers as a full-data single-process run — making the oracle
+    # comparison exact
+    X = rng.randint(0, 32, size=(4000, 10)) / 31.0
+else:
+    X = rng.rand(4000, 10)
 y = X[:, 0] * 3 + X[:, 1] ** 2 + 0.1 * rng.randn(4000)
 
 params = {
@@ -24,7 +38,13 @@ params = {
     "machines": f"127.0.0.1:{port0},127.0.0.1:{port1}",
     "local_listen_port": port0 if rank == 0 else port1,
 }
-bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+if mode == "prepart":
+    params["is_pre_partition"] = True
+    lo, hi = rank * 2000, (rank + 1) * 2000
+    ds = lgb.Dataset(X[lo:hi], label=y[lo:hi])
+else:
+    ds = lgb.Dataset(X, label=y)
+bst = lgb.train(params, ds, num_boost_round=5)
 
 import jax
 assert jax.process_count() == 2, jax.process_count()

@@ -148,3 +148,178 @@ def test_c_api_error_reporting(lib):
                                          None, ctypes.byref(bad))
     assert ret == -1
     assert len(lib.LGBM_GetLastError()) > 0
+
+
+def test_c_api_push_rows_streaming(lib):
+    """Chunked out-of-core ingestion: CreateFromSampledColumn -> PushRows
+    chunks -> FinishLoad -> train (reference c_api.h:67-102)."""
+    rng = np.random.RandomState(7)
+    n, f = 600, 5
+    X = np.ascontiguousarray(rng.rand(n, f), dtype=np.float64)
+    y = np.ascontiguousarray((X[:, 0] + X[:, 2] > 1.0).astype(np.float32))
+
+    # column sample: every value is nonzero here, so sample = the column
+    n_sample = 200
+    sample_cols = [np.ascontiguousarray(X[:n_sample, j]) for j in range(f)]
+    sample_idx = [np.arange(n_sample, dtype=np.int32) for _ in range(f)]
+    col_ptrs = (ctypes.c_void_p * f)(
+        *[c.ctypes.data_as(ctypes.c_void_p).value for c in sample_cols])
+    idx_ptrs = (ctypes.c_void_p * f)(
+        *[c.ctypes.data_as(ctypes.c_void_p).value for c in sample_idx])
+    num_per_col = np.full(f, n_sample, dtype=np.int32)
+
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromSampledColumn(
+        col_ptrs, idx_ptrs, f,
+        num_per_col.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        n_sample, n, b"max_bin=31 min_data_in_leaf=5",
+        ctypes.byref(ds)))
+
+    for start in range(0, n, 200):           # 3 chunks; last triggers finish
+        chunk = np.ascontiguousarray(X[start:start + 200])
+        _check(lib, lib.LGBM_DatasetPushRows(
+            ds, chunk.ctypes.data_as(ctypes.c_void_p), 1, 200, f, start))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0))
+
+    nd = ctypes.c_int()
+    _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)))
+    assert nd.value == n
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 min_data_in_leaf=5 verbose=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    out_len = ctypes.c_int64()
+    preds = np.zeros(n, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 0, 0, b"",
+        ctypes.byref(out_len), preds.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))))
+    acc = np.mean((preds > 0.5) == (y > 0.5))
+    assert acc > 0.85, acc
+
+    # GetNumPredict/GetPredict: training-data scores (c_api.h:488-505)
+    np_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterGetNumPredict(bst, 0, ctypes.byref(np_len)))
+    assert np_len.value == n
+    scores = np.zeros(n, np.float64)
+    got = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterGetPredict(
+        bst, 0, ctypes.byref(got),
+        scores.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert got.value == n
+    # transformed training scores track the (identical-data) predictions
+    assert np.allclose(scores, preds, atol=1e-5)
+
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_api_create_by_reference_csr_push(lib):
+    """CreateByReference + PushRowsByCSR: a valid set streamed in chunks,
+    binned with the training set's mappers (c_api.h:83-127)."""
+    import scipy.sparse as sp
+    rng = np.random.RandomState(11)
+    n, f = 400, 6
+    X = np.ascontiguousarray(rng.rand(n, f), dtype=np.float64)
+    y = np.ascontiguousarray((X[:, 1] > 0.5).astype(np.float32))
+
+    train = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1,
+        b"max_bin=31", None, ctypes.byref(train)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        train, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0))
+
+    nv = 200
+    Xv = np.ascontiguousarray(rng.rand(nv, f), dtype=np.float64)
+    yv = np.ascontiguousarray((Xv[:, 1] > 0.5).astype(np.float32))
+    valid = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateByReference(
+        train, ctypes.c_int64(nv), ctypes.byref(valid)))
+    for start in (0, 100):
+        csr = sp.csr_matrix(Xv[start:start + 100])
+        indptr = np.ascontiguousarray(csr.indptr, np.int32)
+        indices = np.ascontiguousarray(csr.indices, np.int32)
+        data = np.ascontiguousarray(csr.data, np.float64)
+        _check(lib, lib.LGBM_DatasetPushRowsByCSR(
+            valid, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            data.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int64(len(indptr)), ctypes.c_int64(csr.nnz),
+            ctypes.c_int64(f), ctypes.c_int64(start)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        valid, b"label", yv.ctypes.data_as(ctypes.c_void_p), nv, 0))
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        train,
+        b"objective=binary metric=binary_logloss num_leaves=7 verbose=-1",
+        ctypes.byref(bst)))
+    _check(lib, lib.LGBM_BoosterAddValidData(bst, valid))
+    fin = ctypes.c_int()
+    for _ in range(3):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    # valid-set scores exist and have the right length
+    vlen = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterGetNumPredict(bst, 1, ctypes.byref(vlen)))
+    assert vlen.value == nv
+    vscores = np.zeros(nv, np.float64)
+    got = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterGetPredict(
+        bst, 1, ctypes.byref(got),
+        vscores.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert got.value == nv and np.isfinite(vscores).all()
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(valid))
+    _check(lib, lib.LGBM_DatasetFree(train))
+
+
+def test_c_api_booster_merge(lib):
+    """LGBM_BoosterMerge: merged forest's raw score = sum of the parts
+    (boost_from_average off so init terms don't double)."""
+    rng = np.random.RandomState(3)
+    n, f = 300, 4
+    X = np.ascontiguousarray(rng.rand(n, f), dtype=np.float64)
+    y = np.ascontiguousarray((X[:, 0] > 0.5).astype(np.float32))
+    params = (b"objective=binary num_leaves=7 verbose=-1 "
+              b"boost_from_average=false min_data_in_leaf=10")
+
+    def train_one(seed_iters):
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1,
+            b"max_bin=31", None, ctypes.byref(ds)))
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(ds, params, ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(seed_iters):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+        return ds, bst
+
+    def raw_predict(bst):
+        out_len = ctypes.c_int64()
+        preds = np.zeros(n, np.float64)
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 1, 0, b"",
+            ctypes.byref(out_len), preds.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_double))))
+        return preds
+
+    ds1, b1 = train_one(3)
+    ds2, b2 = train_one(2)
+    r1, r2 = raw_predict(b1), raw_predict(b2)
+    _check(lib, lib.LGBM_BoosterMerge(b1, b2))
+    merged = raw_predict(b1)
+    assert np.allclose(merged, r1 + r2, atol=1e-5)
+    for h in (b1, b2):
+        _check(lib, lib.LGBM_BoosterFree(h))
+    for h in (ds1, ds2):
+        _check(lib, lib.LGBM_DatasetFree(h))

@@ -109,3 +109,42 @@ def test_feature_parallel_odd_feature_count():
     _, p_serial = _train_predict(X, y, "serial")
     _, p_feat = _train_predict(X, y, "feature")
     np.testing.assert_array_equal(p_serial, p_feat)
+
+
+def _make_sparse_exclusive(n=3000, f=24, seed=5):
+    """Near-exclusive features: each row has ~1 nonzero column — the shape
+    EFB bundles aggressively (reference FindGroups, dataset.cpp:66-137)."""
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, f))
+    owner = rng.randint(0, f, size=n)
+    X[np.arange(n), owner] = rng.rand(n) * 4 + 1.0
+    y = (X[:, 0] - X[:, 1] + 0.5 * X[:, 2]).astype(np.float64) \
+        + 0.05 * rng.randn(n)
+    return X, y
+
+
+@pytest.mark.parametrize("strategy", ["data", "voting"])
+def test_distributed_efb(strategy):
+    """EFB must engage under the row-sharded strategies (the serial-only
+    restriction is gone) and match the serial-EFB model's quality; for
+    data-parallel the predictions agree to f32 reduction-order tolerance."""
+    X, y = _make_sparse_exclusive()
+    params = dict(objective="regression", num_leaves=15, min_data_in_leaf=5,
+                  device="cpu", verbose=-1)
+
+    bst_serial = lgb.train(dict(params, tree_learner="serial"),
+                           lgb.Dataset(X, label=y), num_boost_round=15,
+                           keep_training_booster=True)
+    assert bst_serial._gbdt.bundle is not None, "EFB should engage (serial)"
+    p_serial = bst_serial.predict(X)
+
+    bst = lgb.train(dict(params, tree_learner=strategy),
+                    lgb.Dataset(X, label=y), num_boost_round=15,
+                    keep_training_booster=True)
+    assert bst._gbdt.bundle is not None, f"EFB should engage ({strategy})"
+    p = bst.predict(X)
+    if strategy == "data":
+        np.testing.assert_allclose(p, p_serial, rtol=1e-4, atol=1e-4)
+    else:
+        mse, mse_serial = np.mean((p - y) ** 2), np.mean((p_serial - y) ** 2)
+        assert mse < mse_serial * 1.25 + 1e-3, (mse, mse_serial)
