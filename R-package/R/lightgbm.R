@@ -104,7 +104,11 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
       init_model$py else init_model
   }
   args$verbose_eval <- verbose > 0L
-  .wrap_booster(do.call(core$train, args))
+  record <- reticulate::dict()
+  args$evals_result <- record
+  bst <- .wrap_booster(do.call(core$train, args))
+  bst$record <- reticulate::py_to_r(record)
+  bst
 }
 
 #' Simple sklearn-style entry point (reference lightgbm.R)
@@ -223,4 +227,100 @@ lgb.model.dt.tree <- function(model, num_iteration = NULL) {
   }
   for (t in trees) walk(t$tree_structure, t$tree_index, NA_integer_)
   do.call(rbind, rows)
+}
+
+#' Persist a Booster inside an RDS file (reference saveRDS.lgb.Booster.R):
+#' the model is serialized to its text form so the RDS survives without the
+#' Python session, and readRDS.lgb.Booster restores a live handle.
+saveRDS.lgb.Booster <- function(object, file, ...) {
+  payload <- list(lgb_model_str = object$py$model_to_string())
+  saveRDS(payload, file = file, ...)
+  invisible(object)
+}
+
+#' Restore a Booster saved with saveRDS.lgb.Booster (reference
+#' readRDS.lgb.Booster.R)
+readRDS.lgb.Booster <- function(file, ...) {
+  payload <- readRDS(file, ...)
+  if (is.null(payload$lgb_model_str)) stop("not a saved lgb.Booster")
+  lgb.load(model_str = payload$lgb_model_str)
+}
+
+#' Per-row feature contributions for selected rows (reference
+#' lgb.interprete.R) — TreeSHAP contributions from the Python core.
+#' Binary/regression models only: a multiclass contribution row is
+#' (F+1)*K wide and needs per-class splitting (reference returns a
+#' per-class list; not yet mirrored here).
+lgb.interprete <- function(model, data, idxset = 1L) {
+  if (model$py$num_model_per_iteration > 1L)
+    stop("lgb.interprete does not support multiclass models yet")
+  m <- .as_matrix(data)
+  contrib <- model$py$predict(m[idxset, , drop = FALSE], pred_contrib = TRUE)
+  contrib <- as.matrix(contrib)
+  feats <- c(unlist(model$py$feature_name()), "BIAS")
+  lapply(seq_len(nrow(contrib)), function(i) {
+    out <- data.frame(Feature = feats,
+                      Contribution = as.numeric(contrib[i, ]),
+                      stringsAsFactors = FALSE)
+    out[order(-abs(out$Contribution)), ]
+  })
+}
+
+#' Barplot of feature importance (reference lgb.plot.importance.R)
+lgb.plot.importance <- function(tree_imp, top_n = 10L,
+                                measure = "Gain", ...) {
+  top <- head(tree_imp[order(-tree_imp[[measure]]), ], top_n)
+  graphics::barplot(rev(top[[measure]]), names.arg = rev(top$Feature),
+                    horiz = TRUE, las = 1,
+                    main = sprintf("Feature importance (%s)", measure), ...)
+  invisible(top)
+}
+
+#' Barplot of one row's contributions (reference lgb.plot.interpretation.R)
+lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L, ...) {
+  top <- head(tree_interpretation, top_n)
+  graphics::barplot(rev(top$Contribution), names.arg = rev(top$Feature),
+                    horiz = TRUE, las = 1,
+                    main = "Feature contribution", ...)
+  invisible(top)
+}
+
+#' Coerce a data.frame's factor/character columns to numeric codes
+#' (reference lgb.prepare.R)
+lgb.prepare <- function(data) {
+  for (j in seq_along(data)) {
+    col <- data[[j]]
+    if (is.factor(col)) data[[j]] <- as.numeric(col)
+    else if (is.character(col)) data[[j]] <- as.numeric(as.factor(col))
+  }
+  data
+}
+
+#' Same as lgb.prepare but returns the coding rules for reuse on new data
+#' (reference lgb.prepare_rules.R)
+lgb.prepare_rules <- function(data, rules = NULL) {
+  if (is.null(rules)) rules <- list()
+  for (j in seq_along(data)) {
+    col <- data[[j]]
+    name <- names(data)[j]
+    if (is.factor(col) || is.character(col)) {
+      lv <- rules[[name]]
+      if (is.null(lv)) {
+        lv <- levels(as.factor(col))
+        rules[[name]] <- lv
+      }
+      data[[j]] <- as.numeric(factor(col, levels = lv))
+    }
+  }
+  list(data = data, rules = rules)
+}
+
+#' Evaluation log of one metric over iterations (reference
+#' lgb.get.eval.result.R) — delegates to the record_evaluation store kept
+#' on the Python booster by lgb.train's callbacks.
+lgb.get.eval.result <- function(booster, data_name, eval_name) {
+  rec <- booster$record
+  if (is.null(rec) || is.null(rec[[data_name]][[eval_name]]))
+    stop(sprintf("no recorded eval for %s/%s", data_name, eval_name))
+  as.numeric(rec[[data_name]][[eval_name]])
 }

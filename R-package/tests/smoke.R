@@ -1,0 +1,44 @@
+# Smoke test for the reticulate bridge (reference R-package/tests/ testthat
+# smoke). Run: Rscript R-package/tests/smoke.R   (needs r-base + reticulate
+# pointing at a python with lightgbm_tpu importable — see R-package/README.md)
+source(file.path(dirname(sub("--file=", "", grep("--file=", commandArgs(FALSE),
+                                                 value = TRUE))), "..", "R",
+                 "lightgbm.R"))
+
+set.seed(1)
+n <- 500
+X <- matrix(runif(n * 6), ncol = 6)
+y <- as.numeric(X[, 1] + X[, 2]^2 + rnorm(n, sd = 0.1))
+
+ds <- lgb.Dataset(X, label = y)
+bst <- lgb.train(params = list(objective = "regression", verbose = -1,
+                               num_leaves = 15, min_data_in_leaf = 5),
+                 data = ds, nrounds = 10, verbose = 0)
+p <- predict(bst, X)
+stopifnot(length(p) == n, all(is.finite(p)))
+stopifnot(mean((p - y)^2) < var(y) * 0.5)
+
+# save / load round trip (text + RDS)
+f <- tempfile(fileext = ".txt")
+lgb.save(bst, f)
+bst2 <- lgb.load(filename = f)
+stopifnot(max(abs(predict(bst2, X) - p)) < 1e-10)
+
+rds <- tempfile(fileext = ".rds")
+saveRDS.lgb.Booster(bst, rds)
+bst3 <- readRDS.lgb.Booster(rds)
+stopifnot(max(abs(predict(bst3, X) - p)) < 1e-10)
+
+# importance + interpretation + model table
+imp <- lgb.importance(bst)
+stopifnot(nrow(imp) >= 1, imp$Feature[1] %in% sprintf("Column_%d", 0:5))
+tree_tbl <- lgb.model.dt.tree(bst)
+stopifnot(nrow(tree_tbl) > 10)
+contrib <- lgb.interprete(bst, X, idxset = 1:2)
+stopifnot(length(contrib) == 2)
+
+# prepare: factor coercion
+df <- data.frame(a = factor(c("x", "y", "x")), b = c(1, 2, 3))
+stopifnot(is.numeric(lgb.prepare(df)$a))
+
+cat("R bridge smoke: OK\n")

@@ -239,6 +239,19 @@ class GBDT:
         ok = np.arange(F_pad) < F                           # padding features off
         self.feature_ok_base = self._put(ok)
 
+        # packed-row code layout for the compacted gather: nibble-pack two
+        # codes/byte at <=16 bins, 6-bit-pack four codes/3 bytes at <=64
+        # (the reference's Dense4bitsBin analog, dense_nbits_bin.hpp:37, and
+        # its own GPU bench config max_bin=63). The Pallas kernel's in-kernel
+        # unpack handles plain byte layouts only — keep u8/u16 there.
+        from ..ops.histogram import code_mode_for, default_code_mode
+        max_code = (bundle_plan.max_bundle_bins if bundle_plan is not None
+                    else train_set.max_num_bin)
+        if hist_kernel == "pallas":
+            code_mode = default_code_mode(Xb.dtype)
+        else:
+            code_mode = code_mode_for(int(max_code), Xb.dtype)
+
         # auto slots: 25 x 5 bf16 channels = 125 matmul columns — one full
         # MXU tile (128) — while quartering the wave count at 255 leaves
         slots = config.tpu_hist_slots or max(1, min(25, num_leaves - 1))
@@ -260,6 +273,7 @@ class GBDT:
             hist_kernel=hist_kernel,
             hist_hilo=config.tpu_hist_hilo,
             hist_bins=self._hist_bins,
+            code_mode=code_mode,
             use_categorical=bool(meta["is_categorical"].any()),
             cat_smooth=config.cat_smooth,
             cat_l2=config.cat_l2,

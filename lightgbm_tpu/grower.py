@@ -134,6 +134,9 @@ class GrowerSpec:
     row_compact: bool = True      # histogram only pending-leaf rows per wave
     hist_bins: int = 0            # bin axis of the histogram BUILD (EFB bundle
                                   # space); 0 = num_bins_padded (unbundled)
+    code_mode: Optional[str] = None  # packed-row code layout (histogram.py
+                                  # code_mode_for): u8 | u16 | u4 | u6;
+                                  # None = plain byte layout by X dtype
     hist_kernel: str = "xla"      # "xla" (one-hot matmul) | "pallas" (fused
                                   # VMEM-accumulator kernel, ops/pallas_histogram.py)
     hist_hilo: bool = True        # bf16 hi/lo channel pairs (~f32 sums) vs
@@ -280,7 +283,7 @@ def grow_tree(
     if spec.row_compact:
         from .ops.histogram import pack_rows
         packed_rows, _ = pack_rows(X_hist, grad, hess, included,
-                                   spec.hist_hilo)
+                                   spec.hist_hilo, spec.code_mode)
     else:
         packed_rows = None
 
@@ -334,7 +337,8 @@ def grow_tree(
                 X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
                 num_slots=S, num_bins_padded=B_hist, chunk_rows=spec.chunk_rows,
                 row_idx=row_idx, n_active=n_active, hilo=spec.hist_hilo,
-                slot_counts=slot_counts, packed=packed_rows)
+                slot_counts=slot_counts, packed=packed_rows,
+                code_mode=spec.code_mode)
 
         if spec.row_compact:
             # Adaptive: a compacted pass pays one stable argsort plus a

@@ -76,26 +76,95 @@ def code_bytes(dtype) -> int:
     return 1 if dtype == jnp.uint8 else 2
 
 
-def pack_rows(X, grad, hess, included, hilo: bool) -> Tuple[jnp.ndarray, int]:
-    """Returns (packed [N, F*cb + 2*ch] u8, code byte count F*cb)."""
+# Code packing modes for the per-row byte layout (the reference's analog is
+# the Dense4bitsBin storage, src/io/dense_nbits_bin.hpp:37 — two codes per
+# byte at <=16 bins; "u6" additionally serves the reference's own GPU bench
+# config max_bin=63, docs/GPU-Performance.rst:105-125, at 3 bytes per 4
+# codes):
+#   "u8"  1 byte/code   (any codes < 256)
+#   "u16" 2 bytes/code  (max_bin > 255)
+#   "u4"  1 byte/2 codes (codes < 16)
+#   "u6"  3 bytes/4 codes (codes < 64)
+# Packed gathers are priced per ROW BYTE by the HBM random-access tax, so
+# u4/u6 cut the compacted pass's gather traffic 2x / 1.33x.
+
+def default_code_mode(dtype) -> str:
+    """Plain byte layout for a bin-code dtype (no bit packing)."""
+    return "u16" if dtype == jnp.uint16 else "u8"
+
+
+def code_mode_for(max_code: int, dtype) -> str:
+    if dtype == jnp.uint16 or max_code > 256:
+        return "u16"
+    if max_code <= 16:
+        return "u4"
+    if max_code <= 64:
+        return "u6"
+    return "u8"
+
+
+def code_bytes_total(F: int, code_mode: str) -> int:
+    return {"u8": F, "u16": 2 * F, "u4": (F + 1) // 2,
+            "u6": ((F + 3) // 4) * 3}[code_mode]
+
+
+def _pack_codes(X: jnp.ndarray, code_mode: str) -> jnp.ndarray:
+    """[N, F] codes -> [N, code_bytes_total(F)] u8 bytes."""
     N, F = X.shape
-    cb = code_bytes(X.dtype)
-    if cb == 1:
-        codes = X
-    else:
+    if code_mode == "u8":
+        return X
+    if code_mode == "u16":
         x16 = X.astype(jnp.uint16)
-        codes = jax.lax.bitcast_convert_type(x16, jnp.uint8).reshape(N, 2 * F)
+        return jax.lax.bitcast_convert_type(x16, jnp.uint8).reshape(N, 2 * F)
+    x = X.astype(jnp.uint8)
+    if code_mode == "u4":
+        if F % 2:
+            x = jnp.pad(x, ((0, 0), (0, 1)))
+        return x[:, 0::2] | (x[:, 1::2] << 4)
+    # u6: 4 six-bit codes -> 3 bytes
+    if F % 4:
+        x = jnp.pad(x, ((0, 0), (0, 4 - F % 4)))
+    q = x.reshape(N, -1, 4)
+    c0, c1, c2, c3 = q[..., 0], q[..., 1], q[..., 2], q[..., 3]
+    b0 = c0 | (c1 << 6)
+    b1 = (c1 >> 2) | (c2 << 4)
+    b2 = (c2 >> 4) | (c3 << 2)
+    return jnp.stack([b0, b1, b2], axis=-1).reshape(N, -1)
+
+
+def pack_rows(X, grad, hess, included, hilo: bool,
+              code_mode: str = None) -> Tuple[jnp.ndarray, int]:
+    """Returns (packed [N, ncb + 2*ch] u8, code byte count ncb)."""
+    N, F = X.shape
+    if code_mode is None:
+        code_mode = default_code_mode(X.dtype)
+    codes = _pack_codes(X, code_mode)
     w = weight_channels(grad, hess, included, hilo)               # [N, ch] bf16
     wb = jax.lax.bitcast_convert_type(w, jnp.uint8).reshape(N, -1)
-    return jnp.concatenate([codes, wb], axis=1), F * cb
+    return jnp.concatenate([codes, wb], axis=1), codes.shape[1]
 
 
-def unpack_codes(xb: jnp.ndarray, F: int, cb: int) -> jnp.ndarray:
-    """[R, F*cb] u8 code bytes -> [R, F] i32 bin codes (inverse bitcast)."""
-    if cb == 1:
+def unpack_codes(xb: jnp.ndarray, F: int, code_mode: str) -> jnp.ndarray:
+    """[R, ncb] u8 code bytes -> [R, F] i32 bin codes (inverse of
+    _pack_codes)."""
+    if code_mode == "u8":
         return xb.astype(jnp.int32)
-    return jax.lax.bitcast_convert_type(
-        xb.reshape(xb.shape[0], F, 2), jnp.uint16).astype(jnp.int32)
+    if code_mode == "u16":
+        return jax.lax.bitcast_convert_type(
+            xb.reshape(xb.shape[0], F, 2), jnp.uint16).astype(jnp.int32)
+    R = xb.shape[0]
+    if code_mode == "u4":
+        out = jnp.stack([xb & 15, xb >> 4], axis=-1).reshape(R, -1)
+        return out[:, :F].astype(jnp.int32)
+    assert code_mode == "u6", code_mode
+    t = xb.reshape(R, -1, 3)
+    b0, b1, b2 = t[..., 0], t[..., 1], t[..., 2]
+    c0 = b0 & 63
+    c1 = (b0 >> 6) | ((b1 & 15) << 2)
+    c2 = (b1 >> 4) | ((b2 & 3) << 4)
+    c3 = b2 >> 2
+    out = jnp.stack([c0, c1, c2, c3], axis=-1).reshape(R, -1)
+    return out[:, :F].astype(jnp.int32)
 
 
 def unpack_weights(wb: jnp.ndarray, ch: int) -> jnp.ndarray:
@@ -201,6 +270,7 @@ def build_histograms(
     packed: jnp.ndarray = None,    # pre-built pack_rows(X, grad, hess,
                                    # included) — pass to amortize the O(N)
                                    # pack across waves of one tree
+    code_mode: str = None,         # packed-row code layout; None = by dtype
 ) -> jnp.ndarray:
     """Returns hist [num_slots, F, num_bins_padded, 3] f32 (sum_g, sum_h, count).
 
@@ -220,10 +290,11 @@ def build_histograms(
     iota_chunk = jnp.arange(chunk_rows, dtype=jnp.int32)
     slot_cum = (jnp.cumsum(slot_counts) if slot_counts is not None else None)
     if compact:
+        if code_mode is None:
+            code_mode = default_code_mode(X.dtype)
         if packed is None:
-            packed, _ = pack_rows(X, grad, hess, included, hilo)
-        ncb = X.shape[1] * code_bytes(X.dtype)
-        cb = code_bytes(X.dtype)
+            packed, _ = pack_rows(X, grad, hess, included, hilo, code_mode)
+        ncb = code_bytes_total(num_features, code_mode)
 
     def chunk_part(i, acc):
         sl = jax.lax.dynamic_slice_in_dim
@@ -232,7 +303,7 @@ def build_histograms(
             pos = i * chunk_rows + iota_chunk
             valid = pos < n_active
             pk = jnp.take(packed, idx, axis=0)                    # [R, Wb] u8
-            xc = unpack_codes(pk[:, :ncb], num_features, cb)
+            xc = unpack_codes(pk[:, :ncb], num_features, code_mode)
             w = unpack_weights(pk[:, ncb:], ch)                   # [R, ch]
             if slot_cum is not None:
                 raw = slot_from_position(pos, slot_cum)

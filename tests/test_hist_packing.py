@@ -1,0 +1,68 @@
+"""Packed-row code layouts (u4 nibble / u6 six-bit) parity tests.
+
+Reference analog: Dense4bitsBin (src/io/dense_nbits_bin.hpp:37) stores two
+<=16-bin codes per byte; the "u6" layout additionally serves the reference's
+GPU benchmark config max_bin=63 (docs/GPU-Performance.rst:105-125) at 3
+bytes per 4 codes. Here the packing only affects the compacted-gather row
+payload — histograms must be IDENTICAL across layouts.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.ops.histogram import (build_histograms, code_bytes_total,
+                                        code_mode_for, pack_rows,
+                                        unpack_codes)
+
+
+@pytest.mark.parametrize("mode,max_code,F", [
+    ("u4", 16, 8), ("u4", 16, 7),            # odd F exercises the pad lane
+    ("u6", 64, 12), ("u6", 64, 10),
+    ("u8", 256, 9), ("u16", 4096, 5),
+])
+def test_pack_unpack_roundtrip(mode, max_code, F):
+    rng = np.random.RandomState(0)
+    dtype = np.uint16 if mode == "u16" else np.uint8
+    X = rng.randint(0, max_code, size=(256, F)).astype(dtype)
+    g = rng.randn(256).astype(np.float32)
+    h = np.abs(rng.randn(256)).astype(np.float32)
+    inc = np.ones(256, np.float32)
+    packed, ncb = pack_rows(jnp.asarray(X), jnp.asarray(g), jnp.asarray(h),
+                            jnp.asarray(inc), True, mode)
+    assert ncb == code_bytes_total(F, mode)
+    codes = np.asarray(unpack_codes(packed[:, :ncb], F, mode))
+    np.testing.assert_array_equal(codes, X.astype(np.int64))
+
+
+def test_code_mode_selection():
+    assert code_mode_for(16, np.dtype(np.uint8)) == "u4"
+    assert code_mode_for(63, np.dtype(np.uint8)) == "u6"
+    assert code_mode_for(255, np.dtype(np.uint8)) == "u8"
+    assert code_mode_for(300, np.dtype(np.uint16)) == "u16"
+
+
+@pytest.mark.parametrize("mode,max_code", [("u4", 15), ("u6", 63)])
+def test_compacted_histogram_matches_full_pass(mode, max_code):
+    """Compacted pass through the packed layout == streaming full pass."""
+    rng = np.random.RandomState(3)
+    N, F, S = 1024, 6, 4
+    B = 64
+    X = jnp.asarray(rng.randint(0, max_code + 1, size=(N, F)), jnp.uint8)
+    g = jnp.asarray(rng.randn(N), jnp.float32)
+    h = jnp.asarray(np.abs(rng.randn(N)), jnp.float32)
+    inc = jnp.ones(N, jnp.float32)
+    leaf_id = jnp.asarray(rng.randint(0, S, size=N), jnp.int32)
+    slot_of_leaf = jnp.arange(S + 1, dtype=jnp.int32).at[S].set(-1)
+
+    full = build_histograms(X, g, h, inc, leaf_id, slot_of_leaf,
+                            num_slots=S, num_bins_padded=B, chunk_rows=256)
+
+    # slot-grouped compacted pass (every row active)
+    order = jnp.argsort(leaf_id, stable=True).astype(jnp.int32)
+    counts = jnp.bincount(leaf_id, length=S).astype(jnp.int32)
+    compact = build_histograms(
+        X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S, num_bins_padded=B,
+        chunk_rows=256, row_idx=order, n_active=jnp.asarray(N, jnp.int32),
+        slot_counts=counts, code_mode=mode)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(compact),
+                               rtol=1e-5, atol=1e-4)

@@ -164,7 +164,7 @@ def test_uint16_codes_pack_roundtrip():
     inc = jnp.ones(n, jnp.float32)
     assert code_bytes(X.dtype) == 2
     packed, ncb = pack_rows(X, g, h, inc, hilo=True)
-    codes = unpack_codes(packed[:, :ncb], f, 2)
+    codes = unpack_codes(packed[:, :ncb], f, "u16")
     np.testing.assert_array_equal(np.asarray(codes), np.asarray(X, np.int32))
 
     leaf_id = jnp.asarray(rng.randint(0, 4, size=n).astype(np.int32))

@@ -1,0 +1,21 @@
+"""R bridge smoke — runs only where an R runtime exists (the build image
+has none; see R-package/README.md for the container recipe)."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+HERE = os.path.dirname(__file__)
+SMOKE = os.path.join(os.path.dirname(HERE), "R-package", "tests", "smoke.R")
+
+
+@pytest.mark.skipif(shutil.which("Rscript") is None,
+                    reason="no R runtime in this image")
+def test_r_bridge_smoke():
+    env = dict(os.environ)
+    env.setdefault("RETICULATE_PYTHON", shutil.which("python3") or "python3")
+    out = subprocess.run(["Rscript", SMOKE], capture_output=True, text=True,
+                         env=env, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "R bridge smoke: OK" in out.stdout

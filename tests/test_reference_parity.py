@@ -1,0 +1,76 @@
+"""Quality parity against the REFERENCE's own example runs.
+
+Oracle values below were produced by building the reference C++ CLI
+(cmake + make from /root/reference, v2.0.10; built out-of-tree) and
+running `lightgbm config=train.conf` on each bundled example — the
+valid_1 metrics it printed at iteration 15 with `max_bin=63 num_trees=15`
+CLI overrides (max_bin=63 is the reference's own GPU benchmark config,
+docs/GPU-Performance.rst:105-125, and keeps this module's CPU training
+budget sane — the emulated-bf16 one-hot matmul scales with bin count):
+
+  binary_classification      auc 0.807646   binary_logloss 0.563039
+  regression                 l2 0.204035
+  multiclass_classification  multi_logloss 1.53897
+  lambdarank                 ndcg@5 0.649591
+
+Training here uses the SAME conf files and data through our engine; the
+assertion is one-sided quality-parity: our valid metric must be NO WORSE
+than the reference's beyond a tolerance covering RNG differences
+(bagging/feature_fraction draw from different generators) — the analog of
+the reference's GPU-vs-CPU accuracy table (docs/GPU-Performance.rst:135-159)
+applied engine-to-engine. Beating the oracle passes (and currently happens
+on binary AUC/logloss and regression l2).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+EXAMPLES = "/root/reference/examples"
+ORACLE_ITERS = 15
+
+
+def _train_from_conf(example: str):
+    conf = os.path.join(EXAMPLES, example, "train.conf")
+    cfg = lgb.Config.from_conf_file(conf)
+    params = {k: v for k, v in cfg.to_dict().items()}
+    params["verbose"] = -1
+    params["max_bin"] = 63
+    cwd = os.path.join(EXAMPLES, example)
+    train = lgb.Dataset(os.path.join(cwd, cfg.data), params=params)
+    vpath = cfg.valid_data[0] if isinstance(cfg.valid_data, list) \
+        else cfg.valid_data
+    valid = lgb.Dataset(os.path.join(cwd, vpath), params=params,
+                        reference=train)
+    bst = lgb.train(params, train, num_boost_round=ORACLE_ITERS,
+                    valid_sets=[valid], valid_names=["valid_1"],
+                    keep_training_booster=True, verbose_eval=False)
+    rows = bst._gbdt.eval_all()
+    return {m: v for (d, m, v, _h) in rows if d == "valid_1"}
+
+
+@pytest.mark.slow
+def test_binary_example_matches_reference():
+    vals = _train_from_conf("binary_classification")
+    assert vals["auc"] > 0.807646 - 0.02, vals
+    assert vals["binary_logloss"] < 0.563039 + 0.05, vals
+
+
+@pytest.mark.slow
+def test_regression_example_matches_reference():
+    vals = _train_from_conf("regression")
+    assert vals["l2"] < 0.204035 * 1.15, vals
+
+
+@pytest.mark.slow
+def test_multiclass_example_matches_reference():
+    vals = _train_from_conf("multiclass_classification")
+    assert vals["multi_logloss"] < 1.53897 + 0.12, vals
+
+
+@pytest.mark.slow
+def test_lambdarank_example_matches_reference():
+    vals = _train_from_conf("lambdarank")
+    assert vals["ndcg@5"] > 0.649591 - 0.04, vals
