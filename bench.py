@@ -169,6 +169,9 @@ def run_bench(deadline, attempt=0):
         min_data_in_leaf=100, verbose=-1, metric="none",
         tpu_hist_kernel=kernel,
     )
+    slots = int(os.environ.get("LGBM_TPU_BENCH_SLOTS", "0"))
+    if slots:
+        params["tpu_hist_slots"] = slots
     ds = lgb.Dataset(X, label=y)
     bst = lgb.Booster(params=params, train_set=ds)
     # what actually runs, read back from the booster's grower spec (not a
@@ -197,6 +200,7 @@ def run_bench(deadline, attempt=0):
         "rows": n_rows,
         "kernel": kernel_resolved,
         "attempt": attempt,
+        **({"hist_slots": slots} if slots else {}),
         "auc": None,
         "auc_parity_gap": None,
     }

@@ -45,8 +45,12 @@ def chain(step, *inputs, reps=3):
     return (time.perf_counter() - t0) / reps
 
 
+# slots sweep: 25 = one 128-col MXU tile of rhs; 51 = two tiles but half
+# the waves per tree (per-wave fixed costs — argsort, routing, scan — are
+# the measured bottleneck, exp/RESULTS.md round-3 breakdown)
 for kern, rc, slots, chunk in [
         ("pallas", True, 25, 512), ("xla", True, 25, 32768),
+        ("xla", True, 51, 32768), ("pallas", True, 51, 512),
         ("pallas", False, 25, 512)]:
     spec = GrowerSpec(num_leaves=L, num_features=F, num_bins_padded=B,
                       chunk_rows=chunk, hist_slots=slots, wave_size=slots,

@@ -107,9 +107,10 @@ def _read_chunks(path: str, fmt: str, has_header: bool):
         yield chunk.to_numpy(dtype=np.float64, copy=False)
 
 
-def _parse_libsvm(lines) -> Tuple[np.ndarray, np.ndarray]:
-    labels = []
-    rows = []
+def _parse_libsvm_rows(lines) -> Tuple[List[float], List[Dict[int, float]], int]:
+    """(labels, per-row {feature: value} dicts, max feature index)."""
+    labels: List[float] = []
+    rows: List[Dict[int, float]] = []
     max_idx = -1
     for line in lines:
         line = line.strip()
@@ -124,11 +125,28 @@ def _parse_libsvm(lines) -> Tuple[np.ndarray, np.ndarray]:
             feats[k] = float(v)
             max_idx = max(max_idx, k)
         rows.append(feats)
+    return labels, rows, max_idx
+
+
+def _parse_libsvm(lines) -> Tuple[np.ndarray, np.ndarray]:
+    labels, rows, max_idx = _parse_libsvm_rows(lines)
     X = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
     for i, feats in enumerate(rows):
         for k, v in feats.items():
             X[i, k] = v
     return X, np.asarray(labels, dtype=np.float64)
+
+
+def _libsvm_line_chunks(path: str, chunk_lines: int = 100_000):
+    with open(path, "r") as fh:
+        buf: List[str] = []
+        for line in fh:
+            buf.append(line)
+            if len(buf) >= chunk_lines:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
 
 
 def _split_columns(mat: np.ndarray, header: Optional[List[str]], params: Dict
@@ -212,7 +230,7 @@ def stream_construct_dataset(path: str, config, feature_names=None,
     head = _head_lines(path)
     fmt = _sniff_format(head[1 if has_header else 0:])
     if fmt == "libsvm":
-        Log.fatal("two-round loading supports csv/tsv only")
+        return _stream_construct_libsvm(path, config, categorical_features)
     header_names: Optional[List[str]] = None
     if has_header:
         sep = "\t" if fmt == "tsv" else ","
@@ -308,13 +326,132 @@ def stream_construct_dataset(path: str, config, feature_names=None,
         metadata.set_weight(weight)
     if group_ids is not None:
         metadata.set_group(_group_ids_to_sizes(group_ids))
-    else:
-        qpath = path + ".query"
-        if os.path.exists(qpath):
-            metadata.set_group(np.loadtxt(qpath, dtype=np.int64))
-        wpath = path + ".weight"
-        if os.path.exists(wpath) and weight is None:
-            metadata.set_weight(np.loadtxt(wpath, dtype=np.float64))
+    _apply_side_files(metadata, path)
 
     return ConstructedDataset(X_binned, features, num_total_features, metadata,
                               feature_names, config)
+
+
+def _stream_construct_libsvm(path: str, config, categorical_features=None):
+    """Two-round streaming construction for LibSVM files (the reference's
+    two-round loading applies to every Parser format,
+    dataset_loader.cpp:159-265; here sparse rows are reservoir-sampled as
+    {feature: value} dicts, bin mappers come from the per-feature NON-ZERO
+    sample values — exactly BinMapper::FindBin's contract, zeros implied by
+    the sample count (bin.cpp:232) — and round two bins each line chunk
+    straight into the uint8/16 matrix)."""
+    from ..binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper, K_EPSILON
+    from ..dataset import ConstructedDataset, FeatureInfo, Metadata, _find_bins
+
+    sample_cnt = int(getattr(config, "bin_construct_sample_cnt", 200000))
+    rng = np.random.RandomState(int(getattr(config, "data_random_seed", 1)))
+
+    # ---- round 1: reservoir-sample sparse rows + count + max feature -----
+    reservoir_rows: List[Dict[int, float]] = []
+    n_seen = 0
+    max_idx = -1
+    for lines in _libsvm_line_chunks(path):
+        _, rows, mi = _parse_libsvm_rows(lines)
+        max_idx = max(max_idx, mi)
+        for feats in rows:
+            if len(reservoir_rows) < sample_cnt:
+                reservoir_rows.append(feats)
+            else:
+                j = rng.randint(0, n_seen + 1)
+                if j < sample_cnt:
+                    reservoir_rows[j] = feats
+            n_seen += 1
+    if n_seen == 0:
+        Log.fatal("Empty data file %s", path)
+    total_rows, num_total_features = n_seen, max_idx + 1
+    feature_names = [f"Column_{i}" for i in range(num_total_features)]
+
+    cat_set = set()
+    if categorical_features is not None:
+        for c in categorical_features:
+            cat_set.add(feature_names.index(c) if isinstance(c, str)
+                        else int(c))
+    from ..dataset import _parse_column_spec
+    cat_set.update(_parse_column_spec(config.categorical_column, feature_names))
+
+    sample_n = len(reservoir_rows)
+    filter_cnt = int(config.min_data_in_leaf * sample_n / max(total_rows, 1))
+    # find_bin's contract is the NONZERO sample (zeros implied by sample_n,
+    # bin.cpp:232) — an explicitly stored 'j:0' entry must be filtered like
+    # sample_for_binning does, or the zero bin double-counts
+    per_feature: Dict[int, List[float]] = {}
+    for feats in reservoir_rows:
+        for k, v in feats.items():
+            if abs(v) > K_EPSILON or np.isnan(v):
+                per_feature.setdefault(k, []).append(v)
+
+    def _find_one(j: int) -> BinMapper:
+        mapper = BinMapper()
+        mapper.find_bin(np.asarray(per_feature.get(j, []), np.float64),
+                        sample_n, config.max_bin, config.min_data_in_bin,
+                        filter_cnt,
+                        BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL,
+                        config.use_missing, config.zero_as_missing)
+        return mapper
+
+    mappers_by_idx = _find_bins(list(range(num_total_features)), _find_one,
+                                config)
+    features = [FeatureInfo(j, mappers_by_idx[j])
+                for j in range(num_total_features)
+                if not mappers_by_idx[j].is_trivial]
+    if not features:
+        Log.warning("There are no meaningful features in %s", path)
+
+    dtype = np.uint8 if all(f.mapper.num_bin <= 256 for f in features) \
+        else np.uint16
+    X_binned = np.zeros((total_rows, max(len(features), 1)), dtype=dtype)
+    label = np.zeros(total_rows, np.float64)
+
+    # zero-bin per used feature (find_bin caches value_to_bin(0) as
+    # default_bin, binning.py:215) — most entries are implicit zeros
+    zero_bins = np.array([f.mapper.default_bin for f in features],
+                         dtype=dtype)
+
+    # ---- round 2: bin each chunk ----------------------------------------
+    row0 = 0
+    inner_of = {f.real_index: i for i, f in enumerate(features)}
+    for lines in _libsvm_line_chunks(path):
+        labs, rows, _ = _parse_libsvm_rows(lines)
+        n = len(rows)
+        if features:
+            block = np.tile(zero_bins, (n, 1))
+            # bin stored values column-wise: group (row, value) by feature
+            cols: Dict[int, Tuple[List[int], List[float]]] = {}
+            for i, feats in enumerate(rows):
+                for k, v in feats.items():
+                    inner = inner_of.get(k)
+                    if inner is not None:
+                        cols.setdefault(inner, ([], []))[0].append(i)
+                        cols[inner][1].append(v)
+            for inner, (ridx, vals) in cols.items():
+                block[np.asarray(ridx), inner] = features[inner].mapper \
+                    .value_to_bin(np.asarray(vals, np.float64)).astype(dtype)
+            X_binned[row0:row0 + n] = block
+        label[row0:row0 + n] = labs
+        row0 += n
+
+    metadata = Metadata(total_rows)
+    metadata.set_label(label)
+    _apply_side_files(metadata, path)
+
+    return ConstructedDataset(X_binned, features, num_total_features,
+                              metadata, feature_names, config)
+
+
+def _apply_side_files(metadata, path: str) -> None:
+    """Pick up <data>.query / .weight / .init side files (reference
+    metadata.cpp conventions) — shared by both two-round paths."""
+    qpath = path + ".query"
+    if os.path.exists(qpath) and metadata.query_boundaries is None:
+        metadata.set_group(np.loadtxt(qpath, dtype=np.int64))
+    wpath = path + ".weight"
+    if os.path.exists(wpath) and metadata.weight is None:
+        metadata.set_weight(np.loadtxt(wpath, dtype=np.float64))
+    ipath = path + ".init"
+    if os.path.exists(ipath) and metadata.init_score is None:
+        metadata.set_init_score(np.loadtxt(ipath, dtype=np.float64))

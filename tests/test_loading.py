@@ -132,3 +132,32 @@ def test_chunked_load_speed(tmp_path):
     dt = time.perf_counter() - t0
     assert X.shape == (n, 10)
     assert dt < 30, f"load took {dt:.1f}s"
+
+
+def test_libsvm_two_round_matches_one_round(tmp_path):
+    """LibSVM two-round streaming construction (the reference's two-round
+    loading covers every Parser format, dataset_loader.cpp:159-265) must
+    produce the same binned matrix as the in-memory one-round load when the
+    sample covers all rows."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(2)
+    n, f = 2000, 10
+    X = np.zeros((n, f))
+    nz = rng.rand(n, f) < 0.3
+    X[nz] = rng.rand(int(nz.sum())) * 5
+    y = (X[:, 0] - X[:, 1] > 0.4).astype(int)
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as fh:
+        for i in range(n):
+            feats = " ".join(f"{j}:{X[i, j]:.6g}" for j in range(f)
+                             if X[i, j] != 0)
+            fh.write(f"{y[i]} {feats}\n")
+    params = {"verbose": -1, "max_bin": 63}
+    one = lgb.Dataset(path, params=dict(params))
+    one.construct()
+    two = lgb.Dataset(path, params=dict(params, use_two_round_loading=True))
+    two.construct()
+    a, b = one._constructed, two._constructed
+    np.testing.assert_array_equal(a.real_feature_idx, b.real_feature_idx)
+    np.testing.assert_array_equal(a.X_binned, b.X_binned)
+    np.testing.assert_array_equal(a.metadata.label, b.metadata.label)
