@@ -3,11 +3,12 @@
 params (machines + local_listen_port + num_machines) and writes the model
 from rank 0.
 
-Modes (reference dataset_loader.cpp:159-221):
+Modes (reference dataset_loader.cpp:159-221, tree_learner.cpp:9-33):
 - full:    every process loads the full data (the non-pre-partitioned path;
-           jax shards rows across the mesh)
+           jax shards rows across the mesh), tree_learner=data
 - prepart: is_pre_partition=true — each process loads ONLY its own row
            shard; global rows are assembled as per-process blocks
+- voting:  full data per process, tree_learner=voting (PV-Tree top-k)
 
 Usage: python multihost_child.py <rank> <port0> <port1> <out_model> [mode]
 """
@@ -43,6 +44,9 @@ if mode == "prepart":
     lo, hi = rank * 2000, (rank + 1) * 2000
     ds = lgb.Dataset(X[lo:hi], label=y[lo:hi])
 else:
+    if mode == "voting":
+        params["tree_learner"] = "voting"
+        params["top_k"] = 5
     ds = lgb.Dataset(X, label=y)
 bst = lgb.train(params, ds, num_boost_round=5)
 

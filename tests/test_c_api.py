@@ -323,3 +323,58 @@ def test_c_api_booster_merge(lib):
         _check(lib, lib.LGBM_BoosterFree(h))
     for h in (ds1, ds2):
         _check(lib, lib.LGBM_DatasetFree(h))
+
+
+def test_c_api_thread_safety(lib):
+    """Two native threads hammer one booster (update vs predict) — the
+    per-handle lock must serialize them without errors or corrupt state
+    (reference Booster mutex, c_api.cpp:29; ctypes releases the GIL around
+    foreign calls, so contention is real)."""
+    import threading
+    rng = np.random.RandomState(5)
+    n, f = 400, 4
+    X = np.ascontiguousarray(rng.rand(n, f), dtype=np.float64)
+    y = np.ascontiguousarray((X[:, 0] > 0.5).astype(np.float32))
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, b"max_bin=31",
+        None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbose=-1", ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    errors = []
+
+    def updater():
+        fin = ctypes.c_int()
+        for _ in range(6):
+            if lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) != 0:
+                errors.append(lib.LGBM_GetLastError().decode())
+
+    def predictor():
+        out_len = ctypes.c_int64()
+        preds = np.zeros(n, np.float64)
+        for _ in range(6):
+            if lib.LGBM_BoosterPredictForMat(
+                    bst, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 0, 0,
+                    b"", ctypes.byref(out_len),
+                    preds.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) != 0:
+                errors.append(lib.LGBM_GetLastError().decode())
+            elif not np.isfinite(preds).all():
+                errors.append("non-finite predictions")
+
+    ts = [threading.Thread(target=updater), threading.Thread(target=predictor)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300)
+    assert not errors, errors
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)))
+    assert it.value == 7, it.value
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))

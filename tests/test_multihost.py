@@ -116,3 +116,18 @@ def test_two_process_pre_partitioned_matches_single_process(tmp_path):
               "device": "cpu", "num_machines": 2}
     bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
     _assert_models_match(multihost_text, bst.model_to_string())
+
+
+@pytest.mark.slow
+def test_two_process_voting_trains(tmp_path):
+    """PV-Tree voting over a real 2-process cluster: the top-k vote psum and
+    selective histogram reduction ride the coordination-service transport;
+    quality is checked against the data the cluster trained on."""
+    text = _run_cluster(tmp_path, "voting")
+    # model parses and predicts close to the data it was trained on
+    rng = np.random.RandomState(7)
+    X = rng.rand(4000, 10)
+    y = X[:, 0] * 3 + X[:, 1] ** 2 + 0.1 * rng.randn(4000)
+    bst = lgb.Booster(model_str=text)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < float(np.var(y)) * 0.5, mse
