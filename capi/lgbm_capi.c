@@ -29,6 +29,12 @@ static __thread char g_last_error[4096] = "everything is fine";
 
 LGBM_EXPORT const char* LGBM_GetLastError(void) { return g_last_error; }
 
+/* exported for external bindings that surface their own errors through the
+   same channel (reference c_api.h LGBM_SetLastError, used by the R shim) */
+LGBM_EXPORT void LGBM_SetLastError(const char* msg) {
+  snprintf(g_last_error, sizeof(g_last_error), "%s", msg ? msg : "unknown");
+}
+
 static void set_error_from_python(void) {
   PyObject *type = NULL, *value = NULL, *tb = NULL;
   PyErr_Fetch(&type, &value, &tb);
