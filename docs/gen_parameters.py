@@ -1,0 +1,44 @@
+"""Generate docs/Parameters.md from the Config dataclass + alias table —
+the analog of the reference's docs/Parameters.rst, kept mechanically in
+sync with the code. Run: python docs/gen_parameters.py"""
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from lightgbm_tpu.config import Config, PARAMETER_ALIASES  # noqa: E402
+
+
+def main():
+    by_canon = {}
+    for alias, canon in PARAMETER_ALIASES.items():
+        by_canon.setdefault(canon, []).append(alias)
+    lines = [
+        "# Parameters",
+        "",
+        "Generated from `lightgbm_tpu/config.py` by `docs/gen_parameters.py`"
+        " — every parameter the reference's string-map config pipeline"
+        " accepts (include/LightGBM/config.h), plus the TPU-specific knobs.",
+        "Aliases resolve exactly like the reference's"
+        " `ParameterAlias::KeyAliasTransform` (config.h:358-514).",
+        "",
+        "| parameter | default | aliases |",
+        "|---|---|---|",
+    ]
+    for f in dataclasses.fields(Config):
+        default = f.default
+        if default is dataclasses.MISSING:
+            default = (f.default_factory()
+                       if f.default_factory is not dataclasses.MISSING
+                       else "")
+        aliases = ", ".join(sorted(by_canon.get(f.name, []))) or "—"
+        lines.append(f"| `{f.name}` | `{default!r}` | {aliases} |")
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "Parameters.md")
+    with open(out, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    print(f"wrote {out}: {len(dataclasses.fields(Config))} parameters")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Basic train/eval/save flow on the reference's binary example data
+(the analog of the reference's examples/python-guide/simple_example.py)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+DATA = "/root/reference/examples/binary_classification"
+
+train = np.loadtxt(f"{DATA}/binary.train")
+test = np.loadtxt(f"{DATA}/binary.test")
+X, y = train[:, 1:], train[:, 0]
+Xt, yt = test[:, 1:], test[:, 0]
+
+ds = lgb.Dataset(X, label=y)
+valid = lgb.Dataset(Xt, label=yt, reference=ds)
+
+params = {"objective": "binary", "metric": ["auc", "binary_logloss"],
+          "num_leaves": 31, "learning_rate": 0.1, "verbose": -1}
+bst = lgb.train(params, ds, num_boost_round=20, valid_sets=[valid],
+                valid_names=["eval"], verbose_eval=5)
+
+preds = bst.predict(Xt)
+acc = float(np.mean((preds > 0.5) == (yt > 0.5)))
+print(f"accuracy: {acc:.4f}")
+assert acc > 0.7
+
+bst.save_model("/tmp/simple_example_model.txt")
+bst2 = lgb.Booster(model_file="/tmp/simple_example_model.txt")
+assert np.allclose(bst2.predict(Xt), preds)
+print("model round-trip OK")

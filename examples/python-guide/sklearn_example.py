@@ -1,0 +1,24 @@
+"""sklearn-API usage: estimators, early stopping, grid search
+(the analog of examples/python-guide/sklearn_example.py)."""
+import numpy as np
+
+from lightgbm_tpu.sklearn import LGBMClassifier, LGBMRegressor
+
+rng = np.random.RandomState(0)
+X = rng.rand(2000, 8)
+y = X[:, 0] * 3 + np.sin(X[:, 1] * 5) + 0.1 * rng.randn(2000)
+
+reg = LGBMRegressor(n_estimators=30, num_leaves=31, learning_rate=0.1)
+reg.fit(X[:1500], y[:1500], eval_set=[(X[1500:], y[1500:])],
+        early_stopping_rounds=5, verbose=False)
+mse = float(np.mean((reg.predict(X[1500:]) - y[1500:]) ** 2))
+print(f"regressor valid mse: {mse:.4f}")
+assert mse < float(np.var(y)) * 0.3
+
+yc = (y > np.median(y)).astype(int)
+clf = LGBMClassifier(n_estimators=20, num_leaves=15)
+clf.fit(X[:1500], yc[:1500])
+acc = float(np.mean(clf.predict(X[1500:]) == yc[1500:]))
+print(f"classifier accuracy: {acc:.4f}")
+assert acc > 0.8
+print("feature importances:", clf.feature_importances_[:4], "...")

@@ -31,7 +31,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import Config
-from ..dataset import ConstructedDataset, Metadata
+from ..dataset import ConstructedDataset, Metadata, MetadataDuckTyping
 from ..grower import GrowerSpec, TreeArrays, grow_tree
 from ..ops.histogram import table_lookup
 from ..parallel.comm import make_parallel_context
@@ -47,7 +47,9 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-class ValidSet:
+class ValidSet(MetadataDuckTyping):
+    # the mixin supplies the duck-typed Dataset surface so user fevals
+    # written against the reference python-package contract keep working
     def __init__(self, name: str, Xb_dev: jnp.ndarray, metadata: Metadata,
                  metrics: List[Metric], num_data: int):
         self.name = name
@@ -56,18 +58,6 @@ class ValidSet:
         self.metrics = metrics
         self.num_data = num_data
         self.score: Optional[jnp.ndarray] = None
-
-    # duck-typed Dataset surface so user fevals written against the reference
-    # python-package contract (feval(preds, eval_data)) keep working
-    def get_label(self):
-        return self.metadata.label
-
-    def get_weight(self):
-        return self.metadata.weight
-
-    def get_group(self):
-        qb = self.metadata.query_boundaries
-        return None if qb is None else np.diff(qb)
 
 
 class GBDT:

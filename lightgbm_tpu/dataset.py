@@ -82,7 +82,28 @@ class FeatureInfo:
     mapper: BinMapper
 
 
-class ConstructedDataset:
+class MetadataDuckTyping:
+    """Duck-typed reference-Dataset surface over ``self.metadata`` — custom
+    objectives and eval functions written against the reference contract
+    (fobj(preds, train_data) -> grad, hess; feval(preds, eval_data);
+    reference basic.py Dataset.get_label) receive objects with this mixin
+    from the boosting loop."""
+
+    def get_label(self):
+        return self.metadata.label
+
+    def get_weight(self):
+        return self.metadata.weight
+
+    def get_group(self):
+        qb = self.metadata.query_boundaries
+        return None if qb is None else np.diff(qb)
+
+    def get_init_score(self):
+        return self.metadata.init_score
+
+
+class ConstructedDataset(MetadataDuckTyping):
     """The binned dataset (reference Dataset, dataset.h:280).
 
     Attributes

@@ -1,0 +1,26 @@
+"""Run every examples/python-guide script (the reference CI's
+TASK=regular runs examples/python-guide/*.py the same way)."""
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(__file__)
+GUIDE = os.path.join(os.path.dirname(HERE), "examples", "python-guide")
+
+
+@pytest.mark.parametrize("script", sorted(
+    os.path.basename(p) for p in glob.glob(os.path.join(GUIDE, "*.py"))))
+def test_example_runs(script):
+    with open(os.path.join(GUIDE, script)) as fh:
+        src = fh.read()
+    if "/root/reference" in src and not os.path.isdir("/root/reference"):
+        pytest.skip("reference example data not mounted")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": os.path.dirname(HERE)})
+    out = subprocess.run([sys.executable, os.path.join(GUIDE, script)],
+                         capture_output=True, text=True, env=env, timeout=480)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
