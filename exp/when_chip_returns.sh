@@ -19,7 +19,12 @@ while true; do
 done
 
 echo "=== bench (full scale, warm the cache) ==="
-LGBM_TPU_BENCH_TIMEOUT=2700 timeout 2900 python bench.py | tee exp/BENCH_local_r3.json
-echo "=== phase_a_check ==="
+LGBM_TPU_BENCH_TIMEOUT=2700 timeout 2900 python bench.py | tee exp/BENCH_local_r4.json
+echo "=== bench slots=51 (two rhs MXU tiles, half the waves) ==="
+LGBM_TPU_BENCH_SLOTS=51 LGBM_TPU_BENCH_TIMEOUT=1200 timeout 1400 \
+  python bench.py | tee exp/BENCH_local_r4_s51.json
+echo "=== phase_a_check (kernel x compact x slots grid) ==="
 timeout 2400 python -u exp/phase_a_check.py
+echo "=== pallas equality ON-CHIP (gate for auto->pallas) ==="
+timeout 1200 python -u exp/pallas_onchip_check.py
 echo "$(date -u +%H:%M:%S) done"
