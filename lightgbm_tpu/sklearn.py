@@ -9,8 +9,25 @@ from .basic import Booster, Dataset
 from .engine import train as _train
 from .utils.log import Log
 
+# Inherit sklearn's base classes when available (the reference does the same
+# through its compat shim, sklearn.py _LGBMModelBase): BaseEstimator supplies
+# __sklearn_tags__/clone support for GridSearchCV & friends, the mixins set
+# the estimator type. Without sklearn the wrappers still work standalone.
+try:
+    from sklearn.base import (BaseEstimator as _SKBase,
+                              ClassifierMixin as _SKClassifier,
+                              RegressorMixin as _SKRegressor)
+except ImportError:                                       # pragma: no cover
+    _SKBase = object
 
-class LGBMModel:
+    class _SKClassifier:                                  # noqa: D401
+        pass
+
+    class _SKRegressor:
+        pass
+
+
+class LGBMModel(_SKBase):
     """Base estimator (reference sklearn.py:137 LGBMModel)."""
 
     def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
@@ -107,6 +124,18 @@ class LGBMModel:
             early_stopping_rounds=None, verbose=False, feature_name="auto",
             categorical_feature="auto", callbacks=None):
         params = self._lgb_params()
+        # callable objective: the reference sklearn wrapper accepts
+        # objective(y_true, y_pred) -> (grad, hess) and routes it as a
+        # custom fobj (sklearn.py:137-213 _ObjectiveFunctionWrapper)
+        fobj = None
+        if callable(params.get("objective")):
+            user_obj = params.pop("objective")
+
+            def fobj(preds, dataset):
+                return user_obj(dataset.get_label(), preds)
+
+            params["objective"] = "none"
+        self._used_custom_obj = fobj is not None
         if eval_metric is not None:
             params["metric"] = eval_metric
         if self.class_weight is not None and sample_weight is None:
@@ -133,7 +162,7 @@ class LGBMModel:
             params, train_set, num_boost_round=self.n_estimators,
             valid_sets=valid_sets, valid_names=valid_names,
             early_stopping_rounds=early_stopping_rounds,
-            evals_result=self.evals_result_,
+            evals_result=self.evals_result_, fobj=fobj,
             verbose_eval=verbose, callbacks=callbacks)
         self._n_features = train_set.num_feature()
         self.best_iteration_ = self._Booster.best_iteration
@@ -167,7 +196,7 @@ class LGBMModel:
         return self._n_features
 
 
-class LGBMRegressor(LGBMModel):
+class LGBMRegressor(_SKRegressor, LGBMModel):
     def __init__(self, **kwargs):
         kwargs.setdefault("objective", "regression")
         super().__init__(**kwargs)
@@ -177,7 +206,7 @@ class LGBMRegressor(LGBMModel):
         return super().fit(X, y, **kwargs)
 
 
-class LGBMClassifier(LGBMModel):
+class LGBMClassifier(_SKClassifier, LGBMModel):
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
 
@@ -197,6 +226,15 @@ class LGBMClassifier(LGBMModel):
     def predict_proba(self, X, raw_score=False, num_iteration=None, **kwargs):
         result = self._Booster.predict(X, raw_score=raw_score,
                                        num_iteration=num_iteration)
+        if getattr(self, "_used_custom_obj", False) and not raw_score:
+            # reference sklearn.py: class probabilities cannot be computed
+            # under a customized objective — warn and return raw scores
+            # (signed margins for binary, so argmax keeps the 0 boundary)
+            Log.warning("Cannot compute class probabilities due to the "
+                        "customized objective function; returning raw scores")
+            if self._n_classes <= 2 and result.ndim == 1:
+                return np.vstack([-result, result]).T
+            return result
         if self._n_classes <= 2 and result.ndim == 1:
             return np.vstack([1.0 - result, result]).T
         return result

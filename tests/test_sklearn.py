@@ -1,0 +1,116 @@
+"""sklearn-API tests mirroring the reference's test_sklearn.py categories
+(tests/python_package_test/test_sklearn.py: regression/binary/multiclass
+thresholds, lambdarank on examples/lambdarank, custom objective, dart,
+grid search, joblib round-trip)."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.sklearn import LGBMClassifier, LGBMRanker, LGBMRegressor
+
+RANK_DIR = "/root/reference/examples/lambdarank"
+
+
+def _reg_data(n=1200, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 8)
+    y = X[:, 0] * 4 + np.sin(X[:, 1] * 5) + 0.1 * rng.randn(n)
+    return X[: n // 2], y[: n // 2], X[n // 2:], y[n // 2:]
+
+
+def test_regressor():
+    Xtr, ytr, Xte, yte = _reg_data()
+    reg = LGBMRegressor(n_estimators=25, num_leaves=31).fit(Xtr, ytr)
+    mse = float(np.mean((reg.predict(Xte) - yte) ** 2))
+    assert mse < float(np.var(yte)) * 0.25, mse
+
+
+def test_classifier_proba_and_classes():
+    rng = np.random.RandomState(1)
+    X = rng.rand(1200, 6)
+    y = np.where(X[:, 0] + X[:, 1] > 1.0, "pos", "neg")     # string labels
+    clf = LGBMClassifier(n_estimators=20, num_leaves=15).fit(X[:800], y[:800])
+    assert set(clf.classes_) == {"neg", "pos"}
+    proba = clf.predict_proba(X[800:])
+    assert proba.shape == (400, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    acc = np.mean(clf.predict(X[800:]) == y[800:])
+    assert acc > 0.85, acc
+
+
+def test_multiclass():
+    rng = np.random.RandomState(2)
+    X = rng.rand(1500, 6)
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0.6).astype(int)
+    clf = LGBMClassifier(n_estimators=15, num_leaves=15).fit(X, y)
+    assert clf.n_classes_ == 3
+    assert np.mean(clf.predict(X) == y) > 0.85
+
+
+@pytest.mark.skipif(not os.path.isdir(RANK_DIR),
+                    reason="reference example data not mounted")
+def test_ranker_on_reference_data():
+    """Lambdarank through the sklearn API on the reference's own ranking
+    example (reference test_sklearn.py:67 does exactly this)."""
+    from lightgbm_tpu.io.file_io import load_data_file
+    X, y, side = load_data_file(os.path.join(RANK_DIR, "rank.train"), {})
+    group = np.asarray(side["group"], dtype=np.int64)   # .query side file
+    rk = LGBMRanker(n_estimators=15, num_leaves=31)
+    rk.fit(X, y, group=group)
+    preds = rk.predict(X)
+    assert np.isfinite(preds).all()
+    # ranking quality: mean score of relevant docs must exceed irrelevant
+    assert preds[y > 0].mean() > preds[y == 0].mean()
+
+
+def test_custom_objective_callable():
+    """objective=callable(y_true, y_pred) -> (grad, hess), the reference's
+    _ObjectiveFunctionWrapper contract."""
+    Xtr, ytr, Xte, yte = _reg_data(seed=3)
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    reg = LGBMRegressor(n_estimators=25, num_leaves=31, objective=l2_obj)
+    reg.fit(Xtr, ytr)
+    mse = float(np.mean((reg.predict(Xte) - yte) ** 2))
+    assert mse < float(np.var(yte)) * 0.3, mse
+
+
+def test_dart_boosting():
+    Xtr, ytr, Xte, yte = _reg_data(seed=4)
+    reg = LGBMRegressor(boosting_type="dart", n_estimators=20,
+                        num_leaves=31, drop_rate=0.2).fit(Xtr, ytr)
+    mse = float(np.mean((reg.predict(Xte) - yte) ** 2))
+    assert mse < float(np.var(yte)) * 0.5, mse
+
+
+def test_grid_search():
+    from sklearn.model_selection import GridSearchCV
+    Xtr, ytr, _, _ = _reg_data(n=600, seed=5)
+    gs = GridSearchCV(LGBMRegressor(n_estimators=8),
+                      {"num_leaves": [7, 15], "learning_rate": [0.1, 0.3]},
+                      cv=2, scoring="neg_mean_squared_error")
+    gs.fit(Xtr, ytr)
+    assert gs.best_params_["num_leaves"] in (7, 15)
+
+
+def test_joblib_pickle_roundtrip(tmp_path):
+    Xtr, ytr, Xte, _ = _reg_data(seed=6)
+    reg = LGBMRegressor(n_estimators=10, num_leaves=15).fit(Xtr, ytr)
+    ref = reg.predict(Xte)
+    blob = pickle.dumps(reg)
+    clone = pickle.loads(blob)
+    np.testing.assert_allclose(clone.predict(Xte), ref, rtol=1e-10)
+
+
+def test_early_stopping_eval_set():
+    Xtr, ytr, Xte, yte = _reg_data(seed=7)
+    reg = LGBMRegressor(n_estimators=200, num_leaves=31, learning_rate=0.3)
+    reg.fit(Xtr, ytr, eval_set=[(Xte, yte)], eval_metric="l2",
+            early_stopping_rounds=3, verbose=False)
+    assert reg.best_iteration_ > 0
+    assert reg.best_iteration_ < 200
+    assert "l2" in next(iter(reg.evals_result_.values()))
