@@ -1,0 +1,34 @@
+"""Hermetic CPU backend arming — the ONE home for the private-API dance.
+
+The axon TPU plugin registers a backend factory at interpreter boot via
+sitecustomize and initializes on first backend access even under
+JAX_PLATFORMS=cpu; a wedged tunnel then hangs every jax call. Dropping the
+factory from the registry before any backend is instantiated makes a
+process provably tunnel-independent. Used by tests/conftest.py, bench.py's
+dry-run mode, and the driver dryrun (all previously private copies).
+"""
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend(device_count: int | None = None) -> None:
+    """Pin jax to the CPU backend, optionally with N virtual devices.
+
+    Must run before the first backend access (imports are fine — backends
+    initialize lazily). Safe to call repeatedly.
+    """
+    if device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count"
+                        f"={device_count}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    from jax._src import xla_bridge
+
+    jax.config.update("jax_platforms", "cpu")
+    for plat in list(xla_bridge._backend_factories):
+        if plat != "cpu":
+            xla_bridge._backend_factories.pop(plat, None)

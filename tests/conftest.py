@@ -10,19 +10,13 @@ never depend on tunnel health, so the factory is dropped from the registry
 before any backend is instantiated.
 """
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from lightgbm_tpu.utils.hermetic import force_cpu_backend  # noqa: E402
 
+force_cpu_backend(device_count=8)
 import jax  # noqa: E402
-from jax._src import xla_bridge  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-for _plat in list(xla_bridge._backend_factories):
-    if _plat != "cpu":
-        xla_bridge._backend_factories.pop(_plat, None)
 
 # Persistent compile cache: the suite is dominated by XLA compiles of the
 # train-step program (full suite >9.5 min cold in round 1); warm reruns skip
