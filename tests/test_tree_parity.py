@@ -24,8 +24,23 @@ import pytest
 import lightgbm_tpu as lgb
 
 HERE = os.path.dirname(__file__)
-FIXTURE = os.path.join(HERE, "fixtures", "ref_binary_det_model.txt")
-TRAIN = "/root/reference/examples/binary_classification/binary.train"
+EXAMPLES = "/root/reference/examples"
+
+BASE = {"num_leaves": 15, "max_bin": 63, "learning_rate": 0.1,
+        "feature_fraction": 1.0, "bagging_freq": 0, "min_data_in_leaf": 50,
+        "min_sum_hessian_in_leaf": 5.0, "verbose": -1, "tpu_wave_size": 1}
+
+CASES = {
+    "binary": ("ref_binary_det_model.txt",
+               "binary_classification/binary.train",
+               {"objective": "binary"}, 5),
+    "regression": ("ref_regression_det_model.txt",
+                   "regression/regression.train",
+                   {"objective": "regression"}, 5),
+    "multiclass": ("ref_multiclass_det_model.txt",
+                   "multiclass_classification/multiclass.train",
+                   {"objective": "multiclass", "num_class": 5}, 3),
+}
 
 
 def _parse_trees(text):
@@ -44,21 +59,19 @@ def _parse_trees(text):
 
 
 @pytest.mark.slow
-@pytest.mark.skipif(not os.path.exists(TRAIN),
+@pytest.mark.skipif(not os.path.isdir(EXAMPLES),
                     reason="reference example data not mounted")
-def test_trees_match_reference_engine():
-    data = np.loadtxt(TRAIN)
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_trees_match_reference_engine(case):
+    fixture, rel_data, extra, rounds = CASES[case]
+    data = np.loadtxt(os.path.join(EXAMPLES, rel_data))
     X, y = data[:, 1:], data[:, 0]
-    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
-              "learning_rate": 0.1, "feature_fraction": 1.0,
-              "bagging_freq": 0, "min_data_in_leaf": 50,
-              "min_sum_hessian_in_leaf": 5.0, "verbose": -1,
-              "tpu_wave_size": 1}
-    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    bst = lgb.train(dict(BASE, **extra), lgb.Dataset(X, label=y),
+                    num_boost_round=rounds)
 
-    ref = _parse_trees(open(FIXTURE).read())
+    ref = _parse_trees(open(os.path.join(HERE, "fixtures", fixture)).read())
     our = _parse_trees(bst.model_to_string())
-    assert len(ref) == len(our) == 5, (len(ref), len(our))
+    assert len(ref) == len(our), (len(ref), len(our))
     total = feat_ok = thr_ok = 0
     for rt, ot in zip(ref, our):
         assert len(rt["f"]) == len(ot["f"])
