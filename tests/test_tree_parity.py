@@ -34,6 +34,9 @@ CASES = {
     "binary": ("ref_binary_det_model.txt",
                "binary_classification/binary.train",
                {"objective": "binary"}, 5),
+    "binary_b255": ("ref_binary255_det_model.txt",
+                    "binary_classification/binary.train",
+                    {"objective": "binary", "max_bin": 255}, 5),
     "regression": ("ref_regression_det_model.txt",
                    "regression/regression.train",
                    {"objective": "regression"}, 5),
