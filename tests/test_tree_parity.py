@@ -37,6 +37,9 @@ CASES = {
     "binary_b255": ("ref_binary255_det_model.txt",
                     "binary_classification/binary.train",
                     {"objective": "binary", "max_bin": 255}, 5),
+    "binary_weighted": ("ref_binary_weighted_det_model.txt",
+                        "binary_classification/binary.train",
+                        {"objective": "binary", "_use_weight": True}, 5),
     "regression": ("ref_regression_det_model.txt",
                    "regression/regression.train",
                    {"objective": "regression"}, 5),
@@ -67,9 +70,14 @@ def _parse_trees(text):
 @pytest.mark.parametrize("case", sorted(CASES))
 def test_trees_match_reference_engine(case):
     fixture, rel_data, extra, rounds = CASES[case]
+    extra = dict(extra)
     data = np.loadtxt(os.path.join(EXAMPLES, rel_data))
     X, y = data[:, 1:], data[:, 0]
-    bst = lgb.train(dict(BASE, **extra), lgb.Dataset(X, label=y),
+    weight = None
+    if extra.pop("_use_weight", False):
+        weight = np.loadtxt(os.path.join(EXAMPLES, rel_data) + ".weight")
+    bst = lgb.train(dict(BASE, **extra),
+                    lgb.Dataset(X, label=y, weight=weight),
                     num_boost_round=rounds)
 
     ref = _parse_trees(open(os.path.join(HERE, "fixtures", fixture)).read())
