@@ -176,9 +176,42 @@ def run_bench(deadline, attempt=0):
         kernel = "xla"
     n_rows = int(os.environ.get("LGBM_TPU_BENCH_ROWS", str(10_500_000)))
     n_holdout = 500_000
-    X, y = _higgs_like(n_rows + n_holdout)
-    Xt, yt = X[n_rows:], y[n_rows:]
-    X, y = X[:n_rows], y[:n_rows]
+
+    # host-side data gen + binning cost ~55 s at full scale on a 1-core host
+    # and is NOT part of the timed loop (the reference's benchmarks exclude
+    # IO the same way, docs/Experiments.rst:99) — cache the raw matrix and
+    # the binned dataset on disk. The key hashes the binning sources so a
+    # binning-code change invalidates stale bins; writes are tmp+rename so
+    # a deadline kill mid-write can never leave a truncated "valid" file.
+    import hashlib
+    import lightgbm_tpu as _pkg
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cache_dir = os.path.join(repo, ".bench_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    src_hash = hashlib.md5()
+    for rel in ("lightgbm_tpu/binning.py", "lightgbm_tpu/dataset.py"):
+        with open(os.path.join(repo, rel), "rb") as fh:
+            src_hash.update(fh.read())
+    key = f"higgs_{n_rows}_{src_hash.hexdigest()[:10]}"
+    rawX_path = os.path.join(cache_dir, key + "_X.npy")
+    rawy_path = os.path.join(cache_dir, key + "_y.npy")
+    bin_path = os.path.join(cache_dir, key + "_b255.bin")
+
+    def _atomic_save_npy(arr, path):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:        # file handle: no .npy suffix games
+            np.save(fh, arr)
+        os.replace(tmp, path)
+
+    if os.path.exists(rawX_path) and os.path.exists(rawy_path):
+        X_all = np.load(rawX_path, mmap_mode="r")
+        y_all = np.load(rawy_path, mmap_mode="r")
+    else:
+        X_all, y_all = _higgs_like(n_rows + n_holdout)
+        _atomic_save_npy(X_all, rawX_path)
+        _atomic_save_npy(y_all, rawy_path)
+    Xt, yt = X_all[n_rows:], y_all[n_rows:]
+    X, y = X_all[:n_rows], y_all[:n_rows]
 
     params = dict(
         objective="binary", num_leaves=255, max_bin=255, learning_rate=0.1,
@@ -188,7 +221,16 @@ def run_bench(deadline, attempt=0):
     slots = int(os.environ.get("LGBM_TPU_BENCH_SLOTS", "0"))
     if slots:
         params["tpu_hist_slots"] = slots
-    ds = lgb.Dataset(X, label=y)
+    if os.path.exists(bin_path):
+        ds = lgb.Dataset(bin_path)
+    else:
+        # construct with the BENCH params so binning-relevant keys
+        # (min_data_in_leaf -> filter_cnt, max_bin, sample_cnt) match what
+        # Booster._setup_train would have used
+        ds = lgb.Dataset(np.asarray(X), label=np.asarray(y), params=params)
+        ds.construct()
+        ds.save_binary(bin_path + ".tmp")
+        os.replace(bin_path + ".tmp", bin_path)
     bst = lgb.Booster(params=params, train_set=ds)
     # what actually runs, read back from the booster's grower spec (not a
     # re-derivation of the auto-resolution rule, which would drift when the
@@ -321,7 +363,15 @@ def run_bench(deadline, attempt=0):
     # the reference's own GPU benchmark config; 4x narrower histograms) -----
     try:
         if deadline() > 240:
-            ds63 = lgb.Dataset(X, label=y)
+            bin63 = os.path.join(cache_dir, key + "_b63.bin")
+            if os.path.exists(bin63):
+                ds63 = lgb.Dataset(bin63)
+            else:
+                ds63 = lgb.Dataset(np.asarray(X), label=np.asarray(y),
+                                   params=dict(params, max_bin=63))
+                ds63.construct()
+                ds63.save_binary(bin63 + ".tmp")
+                os.replace(bin63 + ".tmp", bin63)
             b63 = lgb.Booster(params=dict(params, max_bin=63), train_set=ds63)
             for _ in range(2):
                 b63.update()

@@ -221,36 +221,32 @@ class BinMapper:
 
     @staticmethod
     def _collect_distinct(values: np.ndarray, zero_cnt: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Distinct values + counts with the implicit zeros spliced in
-        (bin.cpp:236-260)."""
-        values = np.sort(values)
+        """Distinct values + counts with the implicit zeros spliced into
+        sorted position (bin.cpp:236-260). Vectorized: the sample filter
+        guarantees |v| > kEpsilon, so 0.0 is never already present and the
+        splice is a single sorted insert."""
         if len(values) == 0:
             return np.array([0.0]), np.array([zero_cnt], dtype=np.int64)
         uniq, cnts = np.unique(values, return_counts=True)
-        out_vals: List[float] = []
-        out_cnts: List[int] = []
-        if uniq[0] > 0.0 and zero_cnt > 0:
-            out_vals.append(0.0)
-            out_cnts.append(zero_cnt)
-        for i in range(len(uniq)):
-            if i > 0 and uniq[i - 1] < 0.0 and uniq[i] > 0.0:
-                out_vals.append(0.0)
-                out_cnts.append(zero_cnt)
-            out_vals.append(float(uniq[i]))
-            out_cnts.append(int(cnts[i]))
-        if uniq[-1] < 0.0 and zero_cnt > 0:
-            out_vals.append(0.0)
-            out_cnts.append(zero_cnt)
-        return np.array(out_vals), np.array(out_cnts, dtype=np.int64)
+        cnts = cnts.astype(np.int64)
+        if zero_cnt > 0:
+            pos = int(np.searchsorted(uniq, 0.0))
+            if pos < len(uniq) and uniq[pos] == 0.0:
+                cnts[pos] += zero_cnt        # defensive: explicit stored zero
+            else:
+                uniq = np.insert(uniq, pos, 0.0)
+                cnts = np.insert(cnts, pos, zero_cnt)
+        return uniq, cnts
 
     def _count_in_bins(self, distinct_values: np.ndarray, counts: np.ndarray,
                        na_cnt: int) -> np.ndarray:
-        cnt_in_bin = np.zeros(self.num_bin, dtype=np.int64)
-        i_bin = 0
-        for i in range(len(distinct_values)):
-            while distinct_values[i] > self.bin_upper_bound[i_bin]:
-                i_bin += 1
-            cnt_in_bin[i_bin] += counts[i]
+        # first bin whose upper bound >= value (the sequential while-advance,
+        # vectorized; a trailing NaN bound compares as +inf in numpy's sort
+        # order so no value lands in the NaN bin here)
+        idx = np.searchsorted(self.bin_upper_bound, distinct_values,
+                              side="left")
+        cnt_in_bin = np.bincount(idx, weights=counts,
+                                 minlength=self.num_bin).astype(np.int64)
         if self.missing_type == MISSING_NAN:
             cnt_in_bin[self.num_bin - 1] = na_cnt
         return cnt_in_bin

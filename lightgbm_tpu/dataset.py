@@ -14,6 +14,7 @@ kept only for config compatibility. Per-feature bin counts stay variable;
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -368,7 +369,11 @@ def construct_dataset(
 
     dtype = np.uint8 if all(f.mapper.num_bin <= 256 for f in features) else np.uint16
     X_binned = np.zeros((num_data, max(len(features), 1)), dtype=dtype)
-    for inner, f in enumerate(features):
+
+    big = num_data * max(len(features), 1) > 8_000_000
+
+    def _bin_column(inner_f):
+        inner, f = inner_f
         if sparse:
             # bin the implicit zeros once, scatter only the stored values
             # (the float matrix is never densified; the dense uint8 bin
@@ -379,7 +384,24 @@ def construct_dataset(
             if len(rows):
                 X_binned[rows, inner] = f.mapper.value_to_bin(vals).astype(dtype)
         else:
-            X_binned[:, inner] = f.mapper.value_to_bin(data[:, f.real_index]).astype(dtype)
+            col = data[:, f.real_index]
+            if big:
+                # one contiguous copy per column: value_to_bin makes several
+                # full passes and a stride-F read thrashes cache on each
+                col = np.ascontiguousarray(col)
+            X_binned[:, inner] = f.mapper.value_to_bin(col).astype(dtype)
+
+    # numpy releases the GIL in the heavy passes — threads help on
+    # multi-core hosts (the analog of the reference's OMP row-parallel push
+    # loop, dataset_loader.cpp:906-1101) and pick 1 worker on 1-core boxes
+    if big:
+        from concurrent.futures import ThreadPoolExecutor
+        workers = min(16, os.cpu_count() or 1, max(len(features), 1))
+        with ThreadPoolExecutor(workers) as pool:
+            list(pool.map(_bin_column, enumerate(features)))
+    else:
+        for item in enumerate(features):
+            _bin_column(item)
 
     metadata = Metadata(num_data)
     if label is not None:
