@@ -16,10 +16,10 @@ enable_compile_cache(repo_cache_dir())
 
 from lightgbm_tpu.grower import GrowerSpec, grow_tree
 
-N = 2 ** 21
+N = int(os.environ.get("LGBM_TPU_PHASE_A_N", str(2 ** 21)))
 F = 28
 B = 256
-L = 255
+L = int(os.environ.get("LGBM_TPU_PHASE_A_LEAVES", "255"))
 rng = np.random.RandomState(0)
 
 Xd = jnp.asarray(rng.randint(0, B, size=(N, F)).astype(np.uint8))
@@ -52,6 +52,8 @@ for kern, rc, slots, chunk in [
         ("pallas", True, 25, 512), ("xla", True, 25, 32768),
         ("xla", True, 51, 32768), ("pallas", True, 51, 512),
         ("pallas", False, 25, 512)]:
+    slots = min(slots, L)              # top_k bound (smoke runs shrink L)
+    chunk = min(chunk, N)              # N must be a chunk multiple
     spec = GrowerSpec(num_leaves=L, num_features=F, num_bins_padded=B,
                       chunk_rows=chunk, hist_slots=slots, wave_size=slots,
                       max_depth=0, lambda_l1=0.0, lambda_l2=0.0,

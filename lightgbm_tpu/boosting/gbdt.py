@@ -243,8 +243,11 @@ class GBDT:
             code_mode = code_mode_for(int(max_code), Xb.dtype)
 
         # auto slots: 25 x 5 bf16 channels = 125 matmul columns — one full
-        # MXU tile (128) — while quartering the wave count at 255 leaves
+        # MXU tile (128) — while quartering the wave count at 255 leaves.
+        # User-set slot counts clamp to the leaf budget: the wave loop's
+        # top_k over [num_leaves+1] gains requires S <= num_leaves.
         slots = config.tpu_hist_slots or max(1, min(25, num_leaves - 1))
+        slots = max(1, min(slots, num_leaves))
         wave = config.tpu_wave_size or slots
         self.spec = GrowerSpec(
             num_leaves=num_leaves,
