@@ -1,9 +1,12 @@
 #!/bin/bash
-# Waits for the axon tunnel to answer, then immediately:
-#   1. re-measures grow_tree after the round-3 optimizations (phase_a_check)
-#   2. runs bench.py at full scale with a generous budget — primes the
-#      persistent compile cache so the driver's end-of-round bench run
-#      starts warm, and records a local result for exp/RESULTS.md.
+# Waits for the axon tunnel to answer, then immediately banks numbers in
+# increasing-cost order (a short tunnel-health window must still produce a
+# nonzero data point — VERDICT r4 #1):
+#   1. QUICK bench (2.1M rows, short budget) -> first nonzero number + warm cache
+#   2. pallas on-chip equality gate -> writes exp/PALLAS_ONCHIP_OK on success
+#   3. full-scale bench (10.5M, auto kernel)
+#   4. full-scale bench with kernel=pallas (only if the gate passed)
+#   5. slots=51 sweep, phase_a_check grid
 # Run: nohup bash exp/when_chip_returns.sh > exp/chip_watch.log 2>&1 &
 cd "$(dirname "$0")/.."
 
@@ -11,20 +14,37 @@ PROBE='import jax, jax.numpy as jnp; print(float(jax.jit(lambda x:(x*2).sum())(j
 
 echo "$(date -u +%H:%M:%S) watching for tunnel..."
 while true; do
-  if timeout 90 python -c "$PROBE" >/dev/null 2>&1; then
+  # cheap TCP check first (refused = instant), then the real 90s jax probe
+  if timeout 5 bash -c 'echo > /dev/tcp/127.0.0.1/8103' 2>/dev/null \
+     && timeout 120 python -c "$PROBE" >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) tunnel is UP"
     break
   fi
-  sleep 120
+  sleep 90
 done
 
-echo "=== bench (full scale, warm the cache) ==="
-LGBM_TPU_BENCH_TIMEOUT=2700 timeout 2900 python bench.py | tee exp/BENCH_local_r4.json
-echo "=== bench slots=51 (two rhs MXU tiles, half the waves) ==="
+echo "=== 1. QUICK bench (2.1M rows) ==="
+LGBM_TPU_BENCH_ROWS=2100000 LGBM_TPU_BENCH_TIMEOUT=900 timeout 1000 \
+  python bench.py | tee exp/BENCH_local_r5_quick.json
+echo "=== 2. pallas equality ON-CHIP (gate for auto->pallas) ==="
+rm -f exp/PALLAS_ONCHIP_OK   # a stale marker from a previous run must not
+                             # un-gate this run's pallas bench
+if timeout 1200 python -u exp/pallas_onchip_check.py; then
+  touch exp/PALLAS_ONCHIP_OK
+  echo "PALLAS GATE: PASS"
+else
+  echo "PALLAS GATE: FAIL (auto stays xla)"
+fi
+echo "=== 3. full bench (10.5M, auto) ==="
+LGBM_TPU_BENCH_TIMEOUT=2700 timeout 2900 python bench.py | tee exp/BENCH_local_r5.json
+if [ -f exp/PALLAS_ONCHIP_OK ]; then
+  echo "=== 4. full bench kernel=pallas ==="
+  LGBM_TPU_BENCH_KERNEL=pallas LGBM_TPU_BENCH_TIMEOUT=1800 timeout 2000 \
+    python bench.py | tee exp/BENCH_local_r5_pallas.json
+fi
+echo "=== 5a. bench slots=51 (two rhs MXU tiles, half the waves) ==="
 LGBM_TPU_BENCH_SLOTS=51 LGBM_TPU_BENCH_TIMEOUT=1200 timeout 1400 \
-  python bench.py | tee exp/BENCH_local_r4_s51.json
-echo "=== phase_a_check (kernel x compact x slots grid) ==="
+  python bench.py | tee exp/BENCH_local_r5_s51.json
+echo "=== 5b. phase_a_check (kernel x compact x slots grid) ==="
 timeout 2400 python -u exp/phase_a_check.py
-echo "=== pallas equality ON-CHIP (gate for auto->pallas) ==="
-timeout 1200 python -u exp/pallas_onchip_check.py
 echo "$(date -u +%H:%M:%S) done"

@@ -179,8 +179,17 @@ class GBDT:
                                 meta["default_bin"].astype(np.int64), config)
             if plan is not None:
                 Bb_pad = max(8, _round_up(plan.max_bundle_bins, 8))
-                # bundle only when it shrinks the one-hot matmul (G*Bb < F*B)
-                if plan.num_groups * Bb_pad < 0.9 * F * Bpad:
+                # bundle when it shrinks the one-hot matmul (G*Bb < F*B), OR
+                # when it at least halves the column count without growing
+                # the matmul much: the per-wave row gather and the HBM
+                # footprint scale with raw column count, so a Bosch-shaped
+                # matrix (many low-bin exclusive columns) still wins even at
+                # equal matmul width — EFB's "densifier" role for sparse
+                # data (dataset.cpp:236-247, sparse_bin.hpp:68)
+                shrinks_matmul = plan.num_groups * Bb_pad < 0.9 * F * Bpad
+                shrinks_cols = (plan.num_groups * 2 <= F
+                                and plan.num_groups * Bb_pad <= 1.25 * F * Bpad)
+                if shrinks_matmul or shrinks_cols:
                     bundle_plan = plan
                     Log.info("EFB: %d features bundled into %d columns "
                              "(%d max bundle bins)", F, plan.num_groups,
