@@ -539,7 +539,8 @@ def run_bench(deadline, attempt=0):
     # ---- wide-sparse (Bosch-shaped) memory + throughput phase -------------
     # subprocess: phase-local hbm peak + crash isolation (see run_sparse_phase)
     try:
-        if deadline() > 420 and platform != "cpu":
+        if (deadline() > 420 and platform != "cpu"
+                and os.environ.get("LGBM_TPU_BENCH_SPARSE", "1") != "0"):
             # reserve ~210s so the wave-vs-exact parity gate (deadline > 150)
             # still runs after this phase
             sp_out = subprocess.run(

@@ -23,8 +23,9 @@ while true; do
   sleep 90
 done
 
-echo "=== 1. QUICK bench (2.1M rows) ==="
-LGBM_TPU_BENCH_ROWS=2100000 LGBM_TPU_BENCH_TIMEOUT=900 timeout 1000 \
+echo "=== 1. QUICK bench (2.1M rows; sparse phase deferred to step 3) ==="
+LGBM_TPU_BENCH_ROWS=2100000 LGBM_TPU_BENCH_SPARSE=0 \
+  LGBM_TPU_BENCH_TIMEOUT=900 timeout 1000 \
   python bench.py | tee exp/BENCH_local_r5_quick.json
 echo "=== 2. pallas equality ON-CHIP (gate for auto->pallas) ==="
 rm -f exp/PALLAS_ONCHIP_OK   # a stale marker from a previous run must not
@@ -39,11 +40,13 @@ echo "=== 3. full bench (10.5M, auto) ==="
 LGBM_TPU_BENCH_TIMEOUT=2700 timeout 2900 python bench.py | tee exp/BENCH_local_r5.json
 if [ -f exp/PALLAS_ONCHIP_OK ]; then
   echo "=== 4. full bench kernel=pallas ==="
-  LGBM_TPU_BENCH_KERNEL=pallas LGBM_TPU_BENCH_TIMEOUT=1800 timeout 2000 \
+  LGBM_TPU_BENCH_KERNEL=pallas LGBM_TPU_BENCH_SPARSE=0 \
+    LGBM_TPU_BENCH_TIMEOUT=1800 timeout 2000 \
     python bench.py | tee exp/BENCH_local_r5_pallas.json
 fi
 echo "=== 5a. bench slots=51 (two rhs MXU tiles, half the waves) ==="
-LGBM_TPU_BENCH_SLOTS=51 LGBM_TPU_BENCH_TIMEOUT=1200 timeout 1400 \
+LGBM_TPU_BENCH_SLOTS=51 LGBM_TPU_BENCH_SPARSE=0 \
+  LGBM_TPU_BENCH_TIMEOUT=1200 timeout 1400 \
   python bench.py | tee exp/BENCH_local_r5_s51.json
 echo "=== 5b. phase_a_check (kernel x compact x slots grid) ==="
 timeout 2400 python -u exp/phase_a_check.py

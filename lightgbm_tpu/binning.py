@@ -229,13 +229,16 @@ class BinMapper:
             return np.array([0.0]), np.array([zero_cnt], dtype=np.int64)
         uniq, cnts = np.unique(values, return_counts=True)
         cnts = cnts.astype(np.int64)
-        if zero_cnt > 0:
-            pos = int(np.searchsorted(uniq, 0.0))
-            if pos < len(uniq) and uniq[pos] == 0.0:
-                cnts[pos] += zero_cnt        # defensive: explicit stored zero
-            else:
-                uniq = np.insert(uniq, pos, 0.0)
-                cnts = np.insert(cnts, pos, zero_cnt)
+        pos = int(np.searchsorted(uniq, 0.0))
+        if pos < len(uniq) and uniq[pos] == 0.0:
+            cnts[pos] += zero_cnt            # defensive: explicit stored zero
+        elif zero_cnt > 0 or 0 < pos < len(uniq):
+            # the edge splices (all-positive / all-negative samples,
+            # bin.cpp:233,257) only fire when zeros exist, but the interior
+            # negative->positive splice (bin.cpp:245-248) is UNGUARDED: a
+            # fully-dense sign-crossing column still gets a (0.0, 0) entry
+            uniq = np.insert(uniq, pos, 0.0)
+            cnts = np.insert(cnts, pos, zero_cnt)
         return uniq, cnts
 
     def _count_in_bins(self, distinct_values: np.ndarray, counts: np.ndarray,

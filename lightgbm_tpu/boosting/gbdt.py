@@ -96,28 +96,48 @@ class GBDT:
                 and self.pctx.strategy in ("data", "voting")):
             from ..parallel.comm import host_allgather
             md = train_set.metadata
-            if md.init_score is not None:
-                Log.fatal("is_pre_partition does not support init_score")
-            if md.query_boundaries is not None:
-                Log.fatal("is_pre_partition does not support query/group "
-                          "data (queries cannot span row shards)")
             blocks = host_allgather(
                 dict(n=int(N), label=np.asarray(md.label, np.float32),
                      weight=None if md.weight is None
-                     else np.asarray(md.weight, np.float32)),
+                     else np.asarray(md.weight, np.float32),
+                     qsizes=None if md.query_boundaries is None
+                     else np.diff(md.query_boundaries).astype(np.int64),
+                     init_score=None if md.init_score is None
+                     else np.asarray(md.init_score, np.float32)),
                 "pre_partition_meta")
             self._block_counts = [int(b["n"]) for b in blocks]
             N = int(sum(self._block_counts))
             meta_global = Metadata(N)
             meta_global.set_label(np.concatenate([b["label"] for b in blocks]))
-            n_weighted = sum(b["weight"] is not None for b in blocks)
-            if n_weighted == len(blocks):
+
+            def _all_or_none(key, what):
+                have = sum(b[key] is not None for b in blocks)
+                if have not in (0, len(blocks)):
+                    Log.fatal("is_pre_partition: %d of %d shards have %s — "
+                              "every shard must provide them or none",
+                              have, len(blocks), what)
+                return bool(have)
+
+            if _all_or_none("weight", "weights"):
                 meta_global.set_weight(
                     np.concatenate([b["weight"] for b in blocks]))
-            elif n_weighted:
-                Log.fatal("is_pre_partition: %d of %d shards have weights — "
-                          "every shard must provide them or none",
-                          n_weighted, len(blocks))
+            # ranking: each shard holds WHOLE queries (the reference loads
+            # full queries per machine and rebuilds query_boundaries from
+            # the used-row set, metadata.cpp:97-127); the global query list
+            # is the block-ordered concatenation of per-shard query sizes
+            if _all_or_none("qsizes", "query/group data"):
+                meta_global.set_group(
+                    np.concatenate([b["qsizes"] for b in blocks]))
+            if _all_or_none("init_score", "init_score"):
+                # per-shard arrays are (k*n_b,) class-major with a common k
+                k = max(len(blocks[0]["init_score"]) // max(blocks[0]["n"], 1),
+                        1)
+                if any(len(b["init_score"]) != k * b["n"] for b in blocks):
+                    Log.fatal("is_pre_partition: init_score length must be "
+                              "the same per-row multiple on every shard")
+                meta_global.set_init_score(np.concatenate(
+                    [b["init_score"].reshape(k, b["n"]) for b in blocks],
+                    axis=1).reshape(-1))
             Log.info("pre-partitioned data: %d rows across %d processes %s",
                      N, len(blocks), self._block_counts)
         self._meta_global = meta_global
@@ -153,6 +173,13 @@ class GBDT:
         Npad = _round_up(per_target, chunk) * Drow
         self.num_data = N
         self.num_data_padded = Npad
+        if (self._block_counts is not None and self.objective is not None
+                and hasattr(self.objective, "set_row_layout")):
+            # pre-partition: real rows sit at interleaved block positions,
+            # not [0, N) — give structured objectives (lambdarank) the
+            # global-row -> device-position map so their gathers stay valid
+            self.objective.set_row_layout(
+                np.asarray(self._real_rows()), Npad)
 
         meta = train_set.feature_meta_arrays()
         num_leaves = config.max_leaves_by_depth
@@ -296,7 +323,9 @@ class GBDT:
 
         # ---- initial scores -------------------------------------------------
         self.init_score_value = 0.0
-        meta_is = train_set.metadata.init_score
+        # meta_global, not train_set.metadata: under pre-partition the local
+        # shard only holds its own init_score slice
+        meta_is = meta_global.init_score
         has_init = meta_is is not None
         if (config.boost_from_average and not has_init and K == 1
                 and self.objective is not None):
@@ -308,7 +337,10 @@ class GBDT:
         if has_init:
             is_arr = np.asarray(meta_is, dtype=np.float32).reshape(K, N, order="C") \
                 if len(meta_is) == K * N else np.tile(np.asarray(meta_is, np.float32), (K, 1))
-            base[:, :N] += is_arr
+            # _row_layout, not [:N]: real rows sit at block positions under
+            # pre-partition
+            base += np.stack([self._row_layout(is_arr[k], Npad)
+                              for k in range(K)])
         self.score = self._put(base, "rows1")
 
         self.models: List[List] = []        # per iteration: list of K device TreeArrays

@@ -549,7 +549,10 @@ def booster_get_predict(handle: int, data_idx: int, out_ptr: int) -> int:
     if gbdt is None:
         raise ValueError("booster has no training data attached")
     if int(data_idx) == 0:
-        scores = gbdt._fetch(gbdt._convert(gbdt.score))[:, : gbdt.num_data]
+        # _real_rows, not [:num_data]: under is_pre_partition the padded
+        # device layout interleaves per-process block padding (gbdt.py:750
+        # uses the same selector for metrics)
+        scores = gbdt._fetch(gbdt._convert(gbdt.score))[:, gbdt._real_rows()]
     else:
         vs = gbdt.valid_sets[int(data_idx) - 1]
         scores = gbdt._fetch(gbdt._convert(vs.score))[:, : vs.num_data]

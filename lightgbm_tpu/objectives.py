@@ -456,8 +456,25 @@ class LambdarankNDCG(Objective):
             })
             base += doc_idx.size
         self.total_slots = base
+        self._pos_of_row_np = pos_of_row
         self.pos_of_row = jnp.asarray(pos_of_row, jnp.int32)
         self.label_gain_dev = jnp.asarray(self.label_gain, jnp.float32)
+        # set by gbdt.set_row_layout under pre-partitioned block layouts
+        self._row_positions_dev = None
+        self._slot_of_device_row = None
+
+    def set_row_layout(self, positions: np.ndarray, npad: int) -> None:
+        """Pre-partitioned device layout hook (boosting/gbdt.py): global row
+        g lives at padded-device position positions[g], with per-process
+        block padding interleaved. Rebuilds the two gathers so gradients()
+        reads scores from and writes grad/hess to the real positions —
+        the reference analog is Metadata::CheckOrPartition re-indexing
+        queries onto the local used-row set (src/io/metadata.cpp:97-127)."""
+        positions = np.asarray(positions, np.int64)
+        self._row_positions_dev = jnp.asarray(positions, jnp.int32)
+        slot = np.full(npad, self.total_slots, dtype=np.int64)  # -> zero slot
+        slot[positions] = self._pos_of_row_np
+        self._slot_of_device_row = jnp.asarray(slot, jnp.int32)
 
     def _query_grads(self, s, l, mask, inv_max_dcg):
         """One padded query: s,l,mask [M]; returns (g, h) [M] in doc order."""
@@ -495,12 +512,17 @@ class LambdarankNDCG(Objective):
 
     def gradients(self, score, label, weight):
         # scores may arrive padded to a chunk multiple (boosting/gbdt.py);
-        # the query structure only covers the first num_data rows.
+        # the query structure only covers the first num_data rows (or, under
+        # a pre-partitioned block layout, the positions set_row_layout gave)
         n = self.num_data
-        pad = score.shape[1] - n
-        s_flat = score[0, :n]
+        if self._row_positions_dev is not None:
+            s_flat = score[0][self._row_positions_dev]
+            l_flat = label[self._row_positions_dev]
+        else:
+            s_flat = score[0, :n]
+            l_flat = label[:n]
         s_ext = jnp.concatenate([s_flat, jnp.zeros(1, s_flat.dtype)])
-        l_ext = jnp.concatenate([label[:n], jnp.zeros(1, label.dtype)])
+        l_ext = jnp.concatenate([l_flat, jnp.zeros(1, label.dtype)])
         parts = []
         for b in self.buckets:
             m = b["m"]
@@ -526,8 +548,18 @@ class LambdarankNDCG(Objective):
             parts.append((gq.reshape(-1)[: nq * m], hq.reshape(-1)[: nq * m]))
         g_cat = jnp.concatenate([p[0] for p in parts])
         h_cat = jnp.concatenate([p[1] for p in parts])
-        g = g_cat[self.pos_of_row]
-        h = h_cat[self.pos_of_row]
+        if self._slot_of_device_row is not None:
+            # one gather lands grad/hess at their device positions; padding
+            # rows point at the appended zero slot
+            gx = jnp.concatenate([g_cat, jnp.zeros(1, g_cat.dtype)])
+            hx = jnp.concatenate([h_cat, jnp.zeros(1, h_cat.dtype)])
+            g = gx[self._slot_of_device_row]
+            h = hx[self._slot_of_device_row]
+            pad = score.shape[1] - self._slot_of_device_row.shape[0]
+        else:
+            g = g_cat[self.pos_of_row]
+            h = h_cat[self.pos_of_row]
+            pad = score.shape[1] - n
         if pad:
             g = jnp.concatenate([g, jnp.zeros(pad, g.dtype)])
             h = jnp.concatenate([h, jnp.zeros(pad, h.dtype)])

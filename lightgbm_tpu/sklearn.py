@@ -232,8 +232,9 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
             # (signed margins for binary, so argmax keeps the 0 boundary)
             Log.warning("Cannot compute class probabilities due to the "
                         "customized objective function; returning raw scores")
-            if self._n_classes <= 2 and result.ndim == 1:
-                return np.vstack([-result, result]).T
+            # reference contract: the raw score array is returned UNCHANGED
+            # (1-D for binary) — downstream code written against the
+            # reference wrapper depends on that shape
             return result
         if self._n_classes <= 2 and result.ndim == 1:
             return np.vstack([1.0 - result, result]).T
@@ -243,8 +244,13 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         if raw_score:
             return self._Booster.predict(X, raw_score=True, num_iteration=num_iteration)
         proba = self.predict_proba(X, num_iteration=num_iteration)
-        idx = np.argmax(proba, axis=1)
-        return self._classes[idx]
+        if proba.ndim == 1:
+            # custom objective: predict_proba returned raw margins (and
+            # warned); the reference wrapper returns them unchanged from
+            # predict() too — class labels cannot be derived without the
+            # objective's link function
+            return proba
+        return self._classes[np.argmax(proba, axis=1)]
 
     @property
     def classes_(self):

@@ -21,8 +21,10 @@ mode = sys.argv[5] if len(sys.argv) > 5 else "full"
 import numpy as np
 import lightgbm_tpu as lgb
 
+from rank_data import rank_data as _rank_data   # sys.path[0] == tests/
+
 rng = np.random.RandomState(7)
-if mode == "prepart":
+if mode in ("prepart", "prepart_rank"):
     # discrete feature values: every shard sees the same distinct set, so
     # distributed bin finding (feature-sharded, local-sample) produces the
     # same mappers as a full-data single-process run — making the oracle
@@ -43,6 +45,22 @@ if mode == "prepart":
     params["is_pre_partition"] = True
     lo, hi = rank * 2000, (rank + 1) * 2000
     ds = lgb.Dataset(X[lo:hi], label=y[lo:hi])
+elif mode == "prepart_rank":
+    # pre-partitioned lambdarank: each rank holds WHOLE queries (reference
+    # metadata.cpp:97-127) plus its slice of init_score; blocks are
+    # intentionally unequal
+    X, y, sizes, init = _rank_data()
+    params["objective"] = "lambdarank"
+    params["is_pre_partition"] = True
+    cum = np.cumsum(sizes)
+    qcut = int(np.searchsorted(cum, 2000))
+    rowcut = int(cum[qcut - 1]) if qcut else 0
+    if rank == 0:
+        ds = lgb.Dataset(X[:rowcut], label=y[:rowcut], group=sizes[:qcut],
+                         init_score=init[:rowcut])
+    else:
+        ds = lgb.Dataset(X[rowcut:], label=y[rowcut:], group=sizes[qcut:],
+                         init_score=init[rowcut:])
 else:
     if mode == "voting":
         params["tree_learner"] = "voting"

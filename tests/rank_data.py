@@ -1,0 +1,19 @@
+"""Shared deterministic lambdarank problem for the pre-partitioned ranking
+tests — imported by BOTH test_multihost.py and multihost_child.py so the
+2-process cluster and the single-process oracle train on identical data."""
+import numpy as np
+
+
+def rank_data():
+    rng = np.random.RandomState(7)
+    X = rng.randint(0, 32, size=(4000, 10)) / 31.0
+    sizes, total = [], 0
+    while total < 4000:
+        q = int(min(rng.randint(5, 40), 4000 - total))
+        sizes.append(q)
+        total += q
+    latent = X[:, 0] * 3 + X[:, 1] ** 2 + rng.randn(4000) * 0.5
+    y = np.searchsorted(np.quantile(latent, [0.5, 0.75, 0.9, 0.97]),
+                        latent).astype(np.float64)
+    init = (0.1 * X[:, 2]).astype(np.float32)
+    return X, y, np.array(sizes, np.int64), init

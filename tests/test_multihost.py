@@ -118,6 +118,26 @@ def test_two_process_pre_partitioned_matches_single_process(tmp_path):
     _assert_models_match(multihost_text, bst.model_to_string())
 
 
+from rank_data import rank_data as _rank_data
+
+
+@pytest.mark.slow
+def test_two_process_pre_partitioned_lambdarank(tmp_path):
+    """Pre-partitioned RANKING data: whole queries per shard + init_score
+    (reference Metadata::CheckOrPartition, metadata.cpp:97-127). The model
+    must match a single-process run over the concatenated queries."""
+    multihost_text = _run_cluster(tmp_path, "prepart_rank")
+
+    X, y, sizes, init = _rank_data()
+    params = {"objective": "lambdarank", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 20, "max_bin": 63, "tree_learner": "data",
+              "device": "cpu", "num_machines": 2}
+    bst = lgb.train(params,
+                    lgb.Dataset(X, label=y, group=sizes, init_score=init),
+                    num_boost_round=5)
+    _assert_models_match(multihost_text, bst.model_to_string())
+
+
 @pytest.mark.slow
 def test_two_process_voting_trains(tmp_path):
     """PV-Tree voting over a real 2-process cluster: the top-k vote psum and
