@@ -187,23 +187,36 @@ class GBDT:
 
         # ---- EFB bundling (reference Dataset::Construct enable_bundle path,
         #      dataset.cpp:236-247): pack near-exclusive features into fewer
-        #      histogram columns. Works for serial AND the row-sharded
-        #      strategies (data/voting — the plan is deterministic and every
-        #      process holds the full matrix, so all ranks agree; the grower
-        #      unpacks to original feature space before the collective, see
-        #      grower.py). Excluded: feature-parallel (columns are already
-        #      block-partitioned, bundling would break the equal blocks) and
-        #      pre-partitioned data (each process would plan from a different
-        #      local shard). ----
+        #      histogram columns, for EVERY learner strategy — EFB precedes
+        #      learner choice in the reference too (dataset.cpp:66-210):
+        #      - serial + row-sharded (data/voting): plan is deterministic and
+        #        every process holds the full matrix; the grower unpacks to
+        #        original feature space before the collective (grower.py);
+        #      - feature-parallel: BUNDLES are the partitioned unit
+        #        (FeatureParallelBundledComm — the reference partitions
+        #        post-EFB feature groups the same way);
+        #      - pre-partitioned: per-shard row samples are KV-allgathered so
+        #        every rank plans from the IDENTICAL sample (the reference
+        #        plans bundles from the same distributed sample it bins from,
+        #        dataset_loader.cpp:820-899), then materializes its local
+        #        shard against the common plan. ----
         self.bundle = None
         bundle_plan = None
-        if (config.enable_bundle and F >= 2
-                and self.pctx.strategy in ("serial", "data", "voting")
-                and self._block_counts is None):
-            from ..efb import plan_bundles
+        if config.enable_bundle and F >= 2:
+            from ..efb import plan_bundles, sample_rows
+            efb_sample = None
+            efb_ndata = None
+            if self._block_counts is not None:
+                from ..parallel.comm import host_allgather
+                per_rank = max(1, 100_000 // len(self._block_counts))
+                parts = host_allgather(
+                    sample_rows(train_set.X_binned, per_rank), "efb_sample")
+                efb_sample = np.concatenate(parts, axis=0)
+                efb_ndata = N
             plan = plan_bundles(train_set.X_binned,
                                 meta["num_bins"].astype(np.int64),
-                                meta["default_bin"].astype(np.int64), config)
+                                meta["default_bin"].astype(np.int64), config,
+                                sample=efb_sample, num_data=efb_ndata)
             if plan is not None:
                 Bb_pad = max(8, _round_up(plan.max_bundle_bins, 8))
                 # bundle when it shrinks the one-hot matmul (G*Bb < F*B), OR
@@ -222,10 +235,14 @@ class GBDT:
                              "(%d max bundle bins)", F, plan.num_groups,
                              plan.max_bundle_bins)
 
+        self._num_bundles_padded = 0
         if bundle_plan is not None:
             Bb_pad = max(8, _round_up(bundle_plan.max_bundle_bins, 8))
             Xb = bundle_plan.X_bundled
-            self.Xb = self._put(np.pad(Xb, ((0, Npad - N), (0, 0))), "rows0")
+            # feature-parallel partitions BUNDLE blocks: G % devices == 0
+            cols_pad = (self.pctx.pad_features_to(Xb.shape[1])
+                        if self.pctx.strategy == "feature" else Xb.shape[1])
+            self._num_bundles_padded = cols_pad
             fpad = F_pad - F
             ub = np.pad(bundle_plan.unpack_bin,
                         ((0, fpad), (0, Bpad - bundle_plan.unpack_bin.shape[1])),
@@ -240,14 +257,18 @@ class GBDT:
             self._hist_bins = Bb_pad
         else:
             Xb = train_set.X_binned
-            if self._block_counts is not None:
-                bp = Npad // len(self._block_counts)
-                local = np.pad(Xb, ((0, bp - Xb.shape[0]), (0, F_pad - F)))
-                self.Xb = self._put_rows0_local(local, Npad)
-            else:
-                self.Xb = self._put(
-                    np.pad(Xb, ((0, Npad - N), (0, F_pad - F))), "rows0")
+            cols_pad = F_pad
             self._hist_bins = 0
+        # device placement of the (possibly bundled) code matrix: rows padded
+        # to Npad (equal per-process blocks under pre-partition, where only
+        # the LOCAL shard exists on this host), columns to the strategy pad
+        col_pad = (0, cols_pad - Xb.shape[1])
+        if self._block_counts is not None:
+            bp = Npad // len(self._block_counts)
+            self.Xb = self._put_rows0_local(
+                np.pad(Xb, ((0, bp - Xb.shape[0]), col_pad)), Npad)
+        else:
+            self.Xb = self._put(np.pad(Xb, ((0, Npad - N), col_pad)), "rows0")
         self.label = self._put(self._row_layout(meta_global.label, Npad), "rows")
         w = meta_global.weight
         self.weight = None if w is None else self._put(
@@ -310,7 +331,11 @@ class GBDT:
             max_cat_to_onehot=config.max_cat_to_onehot,
             min_data_per_group=float(config.min_data_per_group),
         )
-        self.comm = self.pctx.make_comm(F_pad)
+        self.comm = self.pctx.make_comm(
+            F_pad,
+            num_bundles=(self._num_bundles_padded
+                         if self.pctx.strategy == "feature" else 0),
+            bundle_col=None if self.bundle is None else self.bundle.col)
 
         # feature_fraction: number of features used per tree
         self.n_feature_sample = max(1, int(round(config.feature_fraction * F)))

@@ -103,28 +103,48 @@ def _find_groups(masks: np.ndarray, counts: np.ndarray, order: np.ndarray,
     return feats
 
 
+def sample_rows(X_binned: np.ndarray, max_rows: int = _SAMPLE_ROWS,
+                rng_seed: int = 1) -> np.ndarray:
+    """Deterministic row sample for conflict estimation. Exposed so the
+    pre-partitioned path can sample each LOCAL shard, allgather the samples,
+    and hand every rank the identical concatenation (the reference plans
+    bundles from the same distributed sample it bins from,
+    dataset_loader.cpp:820-899)."""
+    N = X_binned.shape[0]
+    if N <= max_rows:
+        return np.asarray(X_binned)
+    rng = np.random.RandomState(rng_seed)
+    rows = rng.choice(N, max_rows, replace=False)
+    return X_binned[np.sort(rows)]
+
+
 def plan_bundles(X_binned: np.ndarray, num_bins: np.ndarray,
                  default_bin: np.ndarray, config,
                  max_group_bins: int = 256,
-                 rng_seed: int = 1) -> Optional[BundlePlan]:
+                 rng_seed: int = 1,
+                 sample: Optional[np.ndarray] = None,
+                 num_data: Optional[int] = None) -> Optional[BundlePlan]:
     """Plan and materialize EFB bundles; None when bundling cannot help.
 
     Mirrors FastFeatureBundling (dataset.cpp:141-215): try both original and
     by-nonzero-count order, keep the grouping with fewer groups. The
     small-sparse-group breakup (:186-203) is intentionally absent: there is
     no sparse bin storage here — dense bundled columns are always the win.
+
+    ``sample``/``num_data`` override the local sample and global row count
+    for the pre-partitioned case: the plan must be a pure function of the
+    (identical) sample so every rank derives the same bundling, while the
+    materialized codes come from the LOCAL ``X_binned`` shard.
     """
     N, F = X_binned.shape
     if F < 2:
         return None
     # conflict estimation on a row sample (the reference uses its
     # bin-construction sample; we sample the materialized bin matrix)
-    if N > _SAMPLE_ROWS:
-        rng = np.random.RandomState(rng_seed)
-        rows = rng.choice(N, _SAMPLE_ROWS, replace=False)
-        sample = X_binned[np.sort(rows)]
-    else:
-        sample = X_binned
+    if sample is None:
+        sample = sample_rows(X_binned, rng_seed=rng_seed)
+    if num_data is None:
+        num_data = N
     S = sample.shape[0]
 
     masks = sample != default_bin[None, :]                   # non-default mask
@@ -132,14 +152,15 @@ def plan_bundles(X_binned: np.ndarray, num_bins: np.ndarray,
     nbins_eff = num_bins - (default_bin == 0).astype(np.int64)
 
     max_error_cnt = int(S * getattr(config, "max_conflict_rate", 0.0))
-    filter_cnt = 0.95 * getattr(config, "min_data_in_leaf", 20) / max(N, 1) * S
+    filter_cnt = (0.95 * getattr(config, "min_data_in_leaf", 20)
+                  / max(num_data, 1) * S)
 
     order1 = np.arange(F)
     order2 = np.argsort(-counts, kind="stable")
     g1 = _find_groups(masks, counts, order1, nbins_eff, max_error_cnt,
-                      filter_cnt, N, max_group_bins)
+                      filter_cnt, num_data, max_group_bins)
     g2 = _find_groups(masks, counts, order2, nbins_eff, max_error_cnt,
-                      filter_cnt, N, max_group_bins)
+                      filter_cnt, num_data, max_group_bins)
     groups = g2 if len(g2) < len(g1) else g1
     if len(groups) >= F:
         return None                                           # nothing bundled

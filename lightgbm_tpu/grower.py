@@ -263,8 +263,18 @@ def grow_tree(
     # leaf totals, so feature blocks stay contiguous and the downstream scan
     # is unchanged. (Bundle-space reduction would hand each device a block
     # of bundles whose member features are non-contiguous.)
+    # ...EXCEPT feature-parallel-over-bundles (FeatureParallelBundledComm):
+    # there the bundle block IS the partition unit, rows are replicated (so
+    # local leaf sums are global and the scan-time FixHistogram subtraction
+    # stays exact), and hist/cache stay in bundle-block space — only the
+    # scan unpacks, with a device-localized column map.
     unbundle_early = (bundle is not None
-                      and getattr(comm, "axis", None) is not None)
+                      and getattr(comm, "axis", None) is not None
+                      and not getattr(comm, "bundled_blocks", False))
+    scan_bundle = bundle
+    if bundle is not None and getattr(comm, "bundled_blocks", False):
+        scan_bundle = bundle._replace(
+            col=comm.localize_bundle_col(bundle.col))
     B_hist = spec.hist_bins or B  # bundle-space bin axis (build side)
     if unbundle_early:
         F_cache = comm.reduced_hist_features(spec.num_features)
@@ -397,7 +407,7 @@ def grow_tree(
         scan_hist = jnp.concatenate([new_hist, sib_hist], axis=0)  # [2S, F, B, 3]
         if bundle is not None and not unbundle_early:
             scan_hist = _unpack_bundled(
-                scan_hist, bundle, state.sum_g[scan_leaves],
+                scan_hist, scan_bundle, state.sum_g[scan_leaves],
                 state.sum_h[scan_leaves], state.cnt[scan_leaves], default_bin)
         # candidate features are GLOBAL indices; under feature/data
         # parallelism this ends in an all-gather argmax across devices

@@ -208,6 +208,68 @@ class FeatureParallelComm:
 
 
 @dataclass(frozen=True)
+class FeatureParallelBundledComm:
+    """Feature-parallel under EFB: BUNDLED COLUMNS are the partitioned unit.
+
+    The reference's feature-parallel learner partitions the dataset's
+    post-EFB feature groups across machines (feature groups ARE the storage
+    unit there, feature_parallel_tree_learner.cpp:31-50 over
+    Dataset::FeatureGroup columns) — partitioning raw features here would
+    tear bundles apart. Each device slices its block of bundled columns,
+    histograms + caches in bundle space (sibling subtraction is linear, so
+    it commutes with the unpack), and scans only its bundles' member
+    features: ``block_meta`` masks ``feature_ok`` to the owned members and
+    the candidates stay full-width / offset-0, so the usual all-gather
+    argmax (SyncUpGlobalBestSplit) is unchanged. Rows are replicated, so
+    local leaf sums are global — the scan-time unpack's FixHistogram
+    subtraction stays valid (dataset.cpp:750-769).
+    """
+    axis: str
+    num_devices: int
+    num_features: int                # F_pad: ORIGINAL feature space width
+    num_bundles: int                 # G_pad: divisible by num_devices
+    bundle_col: object               # [F_pad] i32 bundled column of feature f
+
+    # grower: histograms stay in per-device bundle blocks; the unpack to
+    # original feature space happens at scan time with a localized col map
+    bundled_blocks = True
+
+    @property
+    def block(self) -> int:
+        return self.num_bundles // self.num_devices
+
+    def reduce_scalars(self, *xs):
+        return xs                     # rows replicated -> sums already global
+
+    def hist_X(self, X):
+        i = jax.lax.axis_index(self.axis)
+        return jax.lax.dynamic_slice_in_dim(X, i * self.block, self.block,
+                                            axis=1)
+
+    def reduce_hist(self, hist):
+        return hist                   # [S, G/D, Bb, 3] already global
+
+    reduced_hist_features = SerialComm.reduced_hist_features
+
+    def block_meta(self, feature_ok, num_bins, missing_code, default_bin,
+                   is_cat) -> BlockMeta:
+        i = jax.lax.axis_index(self.axis)
+        owned = jnp.asarray(self.bundle_col) // self.block == i
+        return BlockMeta(feature_ok & owned, num_bins, missing_code,
+                         default_bin, is_cat, jnp.asarray(0, jnp.int32))
+
+    def localize_bundle_col(self, col):
+        """Global [F] bundle-column map -> this device's block-local map
+        (clipped; non-owned features are masked off by ``block_meta``)."""
+        i = jax.lax.axis_index(self.axis)
+        return jnp.clip(col - i * self.block, 0, self.block - 1)
+
+    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
+        return _gather_argmax(find_block_splits(hist, pg, ph, pc, bm, spec),
+                              self.axis)
+
+
+@dataclass(frozen=True)
 class VotingParallelComm:
     """Rows sharded; PV-Tree two-phase split finding with top-k voting."""
     axis: str
@@ -337,10 +399,15 @@ class ParallelContext:
 
     # ---------------------------------------------------------------- comm
 
-    def make_comm(self, num_features: int):
+    def make_comm(self, num_features: int, num_bundles: int = 0,
+                  bundle_col=None):
         if self.strategy == "data":
             return DataParallelComm(self.ROW_AXIS, self.num_devices, num_features)
         if self.strategy == "feature":
+            if num_bundles:
+                return FeatureParallelBundledComm(
+                    self.ROW_AXIS, self.num_devices, num_features,
+                    num_bundles, bundle_col)
             return FeatureParallelComm(self.ROW_AXIS, self.num_devices, num_features)
         if self.strategy == "voting":
             return VotingParallelComm(self.ROW_AXIS, self.num_devices,

@@ -244,11 +244,12 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         if raw_score:
             return self._Booster.predict(X, raw_score=True, num_iteration=num_iteration)
         proba = self.predict_proba(X, num_iteration=num_iteration)
-        if proba.ndim == 1:
+        if proba.ndim == 1 or getattr(self, "_used_custom_obj", False):
             # custom objective: predict_proba returned raw margins (and
             # warned); the reference wrapper returns them unchanged from
             # predict() too — class labels cannot be derived without the
-            # objective's link function
+            # objective's link function (multiclass margins included: a
+            # custom per-class link need not be argmax-preserving)
             return proba
         return self._classes[np.argmax(proba, axis=1)]
 

@@ -30,9 +30,20 @@ if mode in ("prepart", "prepart_rank"):
     # same mappers as a full-data single-process run — making the oracle
     # comparison exact
     X = rng.randint(0, 32, size=(4000, 10)) / 31.0
+elif mode == "prepart_efb":
+    # near-exclusive discrete features: EFB engages, planned from the
+    # KV-allgathered common sample so every rank derives the identical
+    # bundling (reference plans bundles from the distributed sample it
+    # bins from, dataset_loader.cpp:820-899)
+    X = np.zeros((4000, 24))
+    owner = rng.randint(0, 24, size=4000)
+    X[np.arange(4000), owner] = rng.randint(1, 8, size=4000) / 7.0
 else:
     X = rng.rand(4000, 10)
-y = X[:, 0] * 3 + X[:, 1] ** 2 + 0.1 * rng.randn(4000)
+if mode == "prepart_efb":
+    y = X[:, 0] - X[:, 1] + 0.5 * X[:, 2] + 0.05 * rng.randn(4000)
+else:
+    y = X[:, 0] * 3 + X[:, 1] ** 2 + 0.1 * rng.randn(4000)
 
 params = {
     "objective": "regression", "verbose": -1, "num_leaves": 15,
@@ -41,8 +52,10 @@ params = {
     "machines": f"127.0.0.1:{port0},127.0.0.1:{port1}",
     "local_listen_port": port0 if rank == 0 else port1,
 }
-if mode == "prepart":
+if mode in ("prepart", "prepart_efb"):
     params["is_pre_partition"] = True
+    if mode == "prepart_efb":
+        params["min_data_in_leaf"] = 5
     lo, hi = rank * 2000, (rank + 1) * 2000
     ds = lgb.Dataset(X[lo:hi], label=y[lo:hi])
 elif mode == "prepart_rank":
@@ -66,7 +79,10 @@ else:
         params["tree_learner"] = "voting"
         params["top_k"] = 5
     ds = lgb.Dataset(X, label=y)
-bst = lgb.train(params, ds, num_boost_round=5)
+bst = lgb.train(params, ds, num_boost_round=5,
+                keep_training_booster=(mode == "prepart_efb"))
+if mode == "prepart_efb":
+    assert bst._gbdt.bundle is not None, "EFB must engage under pre-partition"
 
 import jax
 assert jax.process_count() == 2, jax.process_count()

@@ -139,6 +139,29 @@ def test_two_process_pre_partitioned_lambdarank(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_pre_partitioned_efb(tmp_path):
+    """EFB under is_pre_partition: every rank plans bundles from the
+    KV-allgathered common row sample (VERDICT r4 #5; the reference plans
+    from the same distributed sample it bins from,
+    dataset_loader.cpp:820-899), so the 2-process pre-partitioned model
+    matches a single-process run over the concatenated data."""
+    multihost_text = _run_cluster(tmp_path, "prepart_efb")
+
+    rng = np.random.RandomState(7)
+    X = np.zeros((4000, 24))
+    owner = rng.randint(0, 24, size=4000)
+    X[np.arange(4000), owner] = rng.randint(1, 8, size=4000) / 7.0
+    y = X[:, 0] - X[:, 1] + 0.5 * X[:, 2] + 0.05 * rng.randn(4000)
+    params = {"objective": "regression", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5, "max_bin": 63, "tree_learner": "data",
+              "device": "cpu", "num_machines": 2}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
+                    keep_training_booster=True)
+    assert bst._gbdt.bundle is not None, "EFB must engage (single-process)"
+    _assert_models_match(multihost_text, bst.model_to_string())
+
+
+@pytest.mark.slow
 def test_two_process_voting_trains(tmp_path):
     """PV-Tree voting over a real 2-process cluster: the top-k vote psum and
     selective histogram reduction ride the coordination-service transport;

@@ -123,11 +123,15 @@ def _make_sparse_exclusive(n=3000, f=24, seed=5):
     return X, y
 
 
-@pytest.mark.parametrize("strategy", ["data", "voting"])
+@pytest.mark.parametrize("strategy", ["data", "voting", "feature"])
 def test_distributed_efb(strategy):
-    """EFB must engage under the row-sharded strategies (the serial-only
-    restriction is gone) and match the serial-EFB model's quality; for
-    data-parallel the predictions agree to f32 reduction-order tolerance."""
+    """EFB must engage under EVERY distributed strategy (EFB precedes
+    learner choice in the reference, dataset.cpp:66-210) and match the
+    serial-EFB model's quality. Row-sharded strategies unpack before the
+    collective; feature-parallel partitions BUNDLES
+    (FeatureParallelBundledComm) the way the reference partitions post-EFB
+    feature groups. data/feature predictions agree to f32 reduction-order
+    tolerance."""
     X, y = _make_sparse_exclusive()
     params = dict(objective="regression", num_leaves=15, min_data_in_leaf=5,
                   device="cpu", verbose=-1)
@@ -143,7 +147,7 @@ def test_distributed_efb(strategy):
                     keep_training_booster=True)
     assert bst._gbdt.bundle is not None, f"EFB should engage ({strategy})"
     p = bst.predict(X)
-    if strategy == "data":
+    if strategy in ("data", "feature"):
         np.testing.assert_allclose(p, p_serial, rtol=1e-4, atol=1e-4)
     else:
         mse, mse_serial = np.mean((p - y) ** 2), np.mean((p_serial - y) ** 2)
