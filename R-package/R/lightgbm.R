@@ -85,7 +85,8 @@ getinfo <- function(dataset, name) {
 #' Train a model (reference lgb.train.R)
 lgb.train <- function(params = list(), data, nrounds = 100L,
                       valids = list(), early_stopping_rounds = NULL,
-                      verbose = 1L, init_model = NULL, ...) {
+                      verbose = 1L, init_model = NULL, callbacks = list(),
+                      ...) {
   core <- .lgb_core()
   args <- list(
     params = params,
@@ -99,6 +100,9 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   if (!is.null(early_stopping_rounds)) {
     args$early_stopping_rounds <- as.integer(early_stopping_rounds)
   }
+  # unname: a NAMED R list converts to a Python dict, and the engine
+  # would then iterate the string keys instead of the callables
+  if (length(callbacks)) args$callbacks <- unname(callbacks)
   if (!is.null(init_model)) {
     args$init_model <- if (inherits(init_model, "lgb.Booster"))
       init_model$py else init_model
@@ -323,4 +327,100 @@ lgb.get.eval.result <- function(booster, data_name, eval_name) {
   if (is.null(rec) || is.null(rec[[data_name]][[eval_name]]))
     stop(sprintf("no recorded eval for %s/%s", data_name, eval_name))
   as.numeric(rec[[data_name]][[eval_name]])
+}
+
+#' Integer variant of lgb.prepare: factor/character columns become integer
+#' codes instead of numeric (reference lgb.prepare2.R)
+lgb.prepare2 <- function(data) {
+  for (j in seq_along(data)) {
+    col <- data[[j]]
+    if (is.factor(col)) data[[j]] <- as.integer(col)
+    else if (is.character(col)) data[[j]] <- as.integer(as.factor(col))
+  }
+  data
+}
+
+#' Integer variant of lgb.prepare_rules: returns reusable level->code rules
+#' and integer-coded columns (reference lgb.prepare_rules2.R)
+lgb.prepare_rules2 <- function(data, rules = NULL) {
+  if (is.null(rules)) rules <- list()
+  for (j in seq_along(data)) {
+    col <- data[[j]]
+    name <- names(data)[j]
+    if (is.factor(col) || is.character(col)) {
+      lv <- rules[[name]]
+      if (is.null(lv)) {
+        lv <- levels(as.factor(col))
+        rules[[name]] <- lv
+      }
+      data[[j]] <- as.integer(factor(col, levels = lv))
+    }
+  }
+  list(data = data, rules = rules)
+}
+
+#' Detach the package (and optionally wipe lgb objects) so a fresh
+#' library(lightgbm) starts clean (reference lgb.unloader.R). The Python
+#' core holds no R-side state beyond the cached reticulate module handles,
+#' which are dropped here too.
+lgb.unloader <- function(restore = TRUE, wipe = FALSE, envir = .GlobalEnv) {
+  if (wipe) {
+    objs <- ls(envir = envir)
+    keep <- vapply(objs, function(nm) {
+      inherits(get(nm, envir = envir), c("lgb.Booster", "lgb.Dataset"))
+    }, logical(1))
+    rm(list = objs[keep], envir = envir)
+    gc(verbose = FALSE)
+  }
+  .lgb_env$core <- NULL
+  .lgb_env$np <- NULL
+  if ("package:lightgbm" %in% search()) {
+    detach("package:lightgbm", unload = TRUE)
+  }
+  if (restore) {
+    suppressMessages(library(lightgbm))
+  }
+  invisible(NULL)
+}
+
+# ---- R-side training callbacks (reference callback.R) ----------------------
+# Each cb.* returns a function taking the Python CallbackEnv (reticulate
+# converts the named tuple); lgb.train passes them through to the core's
+# callbacks= machinery, which drives reset_parameter / logging /
+# evals_result exactly as the Python tests cover.
+
+#' Per-iteration parameter schedule (reference callback.R cb.reset.parameters)
+cb.reset.parameters <- function(new_params) {
+  core <- .lgb_core()
+  py_params <- lapply(new_params, function(p) {
+    if (is.function(p)) reticulate::py_func(p)
+    else if (length(p) > 1L) as.list(p)   # schedule: one value per iteration
+    else p                                # constant: scalar passes through
+  })
+  do.call(core$reset_parameter, py_params)
+}
+
+#' Print eval results every `period` iterations (reference
+#' callback.R cb.print.evaluation)
+cb.print.evaluation <- function(period = 1L) {
+  .lgb_core()$log_evaluation(as.integer(period))
+}
+
+#' Record eval results into a list (reference callback.R
+#' cb.record.evaluation); pass the returned handle's $record to read them
+cb.record.evaluation <- function(record = NULL) {
+  if (is.null(record)) record <- reticulate::dict()
+  else if (!inherits(record, "python.builtin.object"))
+    # convert ONCE and keep the live py dict: a plain R list would be
+    # copied at the boundary and the training-side writes silently lost
+    record <- reticulate::r_to_py(record)
+  cb <- .lgb_core()$record_evaluation(record)
+  attr(cb, "record") <- record
+  cb
+}
+
+#' Early stopping on a validation metric (reference callback.R cb.early.stop)
+cb.early.stop <- function(stopping_rounds, verbose = TRUE) {
+  .lgb_core()$early_stopping(as.integer(stopping_rounds),
+                             verbose = isTRUE(verbose))
 }

@@ -42,3 +42,28 @@ df <- data.frame(a = factor(c("x", "y", "x")), b = c(1, 2, 3))
 stopifnot(is.numeric(lgb.prepare(df)$a))
 
 cat("R bridge smoke: OK\n")
+
+# prepare2 / prepare_rules2: integer coding + rule reuse on new data
+df2 <- data.frame(a = factor(c("x", "y", "x")), b = c(1, 2, 3))
+stopifnot(is.integer(lgb.prepare2(df2)$a))
+pr <- lgb.prepare_rules2(df2)
+new_df <- data.frame(a = factor(c("y", "x")), b = c(4, 5))
+coded <- lgb.prepare_rules2(new_df, rules = pr$rules)
+stopifnot(identical(coded$data$a, c(2L, 1L)))
+
+# callbacks: record + print handles flow through lgb.train
+rec_cb <- cb.record.evaluation()
+ds_v <- lgb.Dataset(X[1:100, ], label = y[1:100], reference = ds)
+bst_cb <- lgb.train(params = list(objective = "regression", verbose = -1,
+                                  num_leaves = 15, min_data_in_leaf = 5,
+                                  metric = "l2"),
+                    data = ds, nrounds = 5, valids = list(v = ds_v),
+                    callbacks = list(rec_cb, cb.print.evaluation(10L)),
+                    verbose = 0)
+rec <- reticulate::py_to_r(attr(rec_cb, "record"))
+stopifnot(length(rec$v$l2) == 5)
+
+# unloader drops the cached module handles without error
+lgb.unloader(restore = FALSE)
+
+cat("R bridge extended smoke: OK\n")

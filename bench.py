@@ -499,8 +499,13 @@ def run_bench(deadline, attempt=0):
             result["reference_example_auc"] = round(
                 _auc(te[:, 0], bref.predict(te[:, 1:])), 6)
             # the reference CLI's valid auc on this exact run (train.conf,
-            # 100 iters; see tests/test_reference_parity.py provenance)
-            result["reference_example_auc_oracle"] = 0.824303
+            # 100 iters) — loaded from the provenance fixture written by
+            # tests/gen_oracles.py (config/data hashes recorded there)
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "tests",
+                    "fixtures", "oracles.json")) as fh:
+                result["reference_example_auc_oracle"] = \
+                    json.load(fh)["bench_reference_example"]["auc"]
     except BenchTimeout:
         raise
     except Exception as e:                                   # noqa: BLE001

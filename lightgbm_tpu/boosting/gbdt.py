@@ -164,6 +164,10 @@ class GBDT:
         if hist_kernel == "auto":
             hist_kernel = "xla"
             Log.debug("tpu_hist_kernel=auto resolved to %s", hist_kernel)
+        if config.tpu_hist_f64 and hist_kernel == "pallas":
+            Log.warning("tpu_hist_f64 requires the xla histogram kernel; "
+                        "overriding tpu_hist_kernel=pallas")
+            hist_kernel = "xla"
         chunk = min(config.tpu_hist_chunk, _round_up(per_target, 256))
         if hist_kernel == "pallas":
             # measured fastest grid step AND safely inside the 16MB scoped
@@ -203,12 +207,12 @@ class GBDT:
         self.bundle = None
         bundle_plan = None
         if config.enable_bundle and F >= 2:
-            from ..efb import plan_bundles, sample_rows
+            from ..efb import _SAMPLE_ROWS, plan_bundles, sample_rows
             efb_sample = None
             efb_ndata = None
             if self._block_counts is not None:
                 from ..parallel.comm import host_allgather
-                per_rank = max(1, 100_000 // len(self._block_counts))
+                per_rank = max(1, _SAMPLE_ROWS // len(self._block_counts))
                 parts = host_allgather(
                     sample_rows(train_set.X_binned, per_rank), "efb_sample")
                 efb_sample = np.concatenate(parts, axis=0)
@@ -322,6 +326,7 @@ class GBDT:
             row_compact=config.tpu_row_compact,
             hist_kernel=hist_kernel,
             hist_hilo=config.tpu_hist_hilo,
+            hist_f64=config.tpu_hist_f64,
             hist_bins=self._hist_bins,
             code_mode=code_mode,
             use_categorical=bool(meta["is_categorical"].any()),

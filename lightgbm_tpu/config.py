@@ -280,6 +280,15 @@ class Config:
     tpu_hist_chunk: int = 32768
     # accumulate g/h as bf16 hi+lo pairs (~f32 precision) vs plain bf16
     tpu_hist_hilo: bool = True
+    # High-precision histogram accumulation: full-f32 weight columns
+    # contracted at Precision.HIGHEST (exact products) + Kahan-compensated
+    # chunk carry — the role of the reference's double HistogramBinEntry
+    # (bin.h:29-31). Measured ~30x tighter bin sums vs the bf16 hi/lo
+    # default (tests/test_hist_packing.py::test_hist_f64_precision). The
+    # split SCAN still runs in f32, so near-tie node flips vs the reference
+    # (test_tree_parity.py) are narrowed, not guaranteed closed. Forces the
+    # xla kernel.
+    tpu_hist_f64: bool = False
     # number of leaf slots whose histograms are built in one pass
     tpu_hist_slots: int = 0                   # 0 = auto
     # row compaction: each wave histograms only rows in pending leaves via a

@@ -141,6 +141,10 @@ class GrowerSpec:
                                   # VMEM-accumulator kernel, ops/pallas_histogram.py)
     hist_hilo: bool = True        # bf16 hi/lo channel pairs (~f32 sums) vs
                                   # single bf16 (GPU-reference-style tradeoff)
+    hist_f64: bool = False        # Kahan-compensated chunk accumulation:
+                                  # ~f64-accurate bin sums like the
+                                  # reference's double HistogramBinEntry
+                                  # (bin.h:29-31); xla kernel only
     # categorical split search (reference config.h:230-234)
     use_categorical: bool = False
     cat_smooth: float = 10.0
@@ -290,10 +294,17 @@ def grow_tree(
     # bytes): the compacted waves gather rows from it with a single random
     # access each; building it is an O(N) sequential write paid once here
     # instead of per wave
+    # weight-channel mode: hist_f64 carries full f32 channels (exact
+    # products at Precision.HIGHEST + Kahan chunk carry in build_histograms).
+    # Guard at the mechanism: the pallas kernel unpacks packed weights as
+    # bf16 unconditionally, so f32-mode rows would silently decode garbage
+    assert not (spec.hist_f64 and spec.hist_kernel == "pallas"), \
+        "tpu_hist_f64 requires the xla histogram kernel"
+    wmode = "f32" if spec.hist_f64 else spec.hist_hilo
     if spec.row_compact:
         from .ops.histogram import pack_rows
         packed_rows, _ = pack_rows(X_hist, grad, hess, included,
-                                   spec.hist_hilo, spec.code_mode)
+                                   wmode, spec.code_mode)
     else:
         packed_rows = None
 
@@ -346,9 +357,9 @@ def grow_tree(
             return build_histograms(
                 X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
                 num_slots=S, num_bins_padded=B_hist, chunk_rows=spec.chunk_rows,
-                row_idx=row_idx, n_active=n_active, hilo=spec.hist_hilo,
+                row_idx=row_idx, n_active=n_active, hilo=wmode,
                 slot_counts=slot_counts, packed=packed_rows,
-                code_mode=spec.code_mode)
+                code_mode=spec.code_mode, compensated=spec.hist_f64)
 
         if spec.row_compact:
             # Adaptive: a compacted pass pays one stable argsort plus a

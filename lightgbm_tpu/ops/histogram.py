@@ -29,27 +29,39 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-# channels per leaf slot: g_hi, g_lo, h_hi, h_lo, count (hi/lo mode) or
-# g, h, count (tpu_hist_hilo=false — single bf16 rounding, the reference
-# GPU path's f32-and-accept-tiny-deltas tradeoff at 40% fewer columns)
+# Weight-channel modes (the `hilo` parameter throughout):
+#   True  — g_hi, g_lo, h_hi, h_lo, count bf16 hi/lo pairs (~f32 sums)
+#   False — g, h, count single bf16 (the reference GPU path's
+#           f32-and-accept-tiny-deltas tradeoff at 40% fewer columns)
+#   "f32" — g, h, count full f32 columns contracted at Precision.HIGHEST
+#           (exact per-element products; tpu_hist_f64's exactness half —
+#           the Kahan carry in build_histograms is the other)
 NUM_CHANNELS = 5
 NUM_CHANNELS_FAST = 3
 
 
-def weight_channels(grad, hess, included, hilo: bool):
-    """[N, ch] bf16 weight channels for the one-hot matmul."""
-    if hilo:
+def num_channels(hilo) -> int:
+    return NUM_CHANNELS if hilo is True else NUM_CHANNELS_FAST
+
+
+def weight_channels(grad, hess, included, hilo):
+    """[N, ch] weight channels for the one-hot matmul (dtype by mode)."""
+    if hilo is True:
         g_hi, g_lo = _split_hi_lo(grad)
         h_hi, h_lo = _split_hi_lo(hess)
         return jnp.stack([g_hi, g_lo, h_hi, h_lo,
                           included.astype(jnp.bfloat16)], axis=-1)
+    if hilo == "f32":
+        return jnp.stack([grad.astype(jnp.float32),
+                          hess.astype(jnp.float32),
+                          included.astype(jnp.float32)], axis=-1)
     return jnp.stack([grad.astype(jnp.bfloat16), hess.astype(jnp.bfloat16),
                       included.astype(jnp.bfloat16)], axis=-1)
 
 
-def combine_channels(acc, hilo: bool):
+def combine_channels(acc, hilo):
     """[..., ch] f32 accumulated channels -> [..., 3] (sum_g, sum_h, cnt)."""
-    if hilo:
+    if hilo is True:
         return jnp.stack([acc[..., 0] + acc[..., 1],
                           acc[..., 2] + acc[..., 3], acc[..., 4]], axis=-1)
     return acc[..., :3]
@@ -132,14 +144,14 @@ def _pack_codes(X: jnp.ndarray, code_mode: str) -> jnp.ndarray:
     return jnp.stack([b0, b1, b2], axis=-1).reshape(N, -1)
 
 
-def pack_rows(X, grad, hess, included, hilo: bool,
+def pack_rows(X, grad, hess, included, hilo,
               code_mode: str = None) -> Tuple[jnp.ndarray, int]:
-    """Returns (packed [N, ncb + 2*ch] u8, code byte count ncb)."""
+    """Returns (packed [N, ncb + weight bytes] u8, code byte count ncb)."""
     N, F = X.shape
     if code_mode is None:
         code_mode = default_code_mode(X.dtype)
     codes = _pack_codes(X, code_mode)
-    w = weight_channels(grad, hess, included, hilo)               # [N, ch] bf16
+    w = weight_channels(grad, hess, included, hilo)     # [N, ch] bf16 or f32
     wb = jax.lax.bitcast_convert_type(w, jnp.uint8).reshape(N, -1)
     return jnp.concatenate([codes, wb], axis=1), codes.shape[1]
 
@@ -167,8 +179,11 @@ def unpack_codes(xb: jnp.ndarray, F: int, code_mode: str) -> jnp.ndarray:
     return out[:, :F].astype(jnp.int32)
 
 
-def unpack_weights(wb: jnp.ndarray, ch: int) -> jnp.ndarray:
-    """[R, 2*ch] u8 -> [R, ch] bf16 weight channels."""
+def unpack_weights(wb: jnp.ndarray, ch: int, f32: bool = False) -> jnp.ndarray:
+    """[R, bytes*ch] u8 -> [R, ch] bf16 (or f32) weight channels."""
+    if f32:
+        return jax.lax.bitcast_convert_type(
+            wb.reshape(wb.shape[0], ch, 4), jnp.float32)
     return jax.lax.bitcast_convert_type(
         wb.reshape(wb.shape[0], ch, 2), jnp.bfloat16)
 
@@ -271,6 +286,11 @@ def build_histograms(
                                    # included) — pass to amortize the O(N)
                                    # pack across waves of one tree
     code_mode: str = None,         # packed-row code layout; None = by dtype
+    compensated: bool = False,     # Kahan-compensate the chunk accumulation:
+                                   # ~f64-accurate bin sums (the reference
+                                   # accumulates bins in f64, bin.h:29-31)
+                                   # without f64 hardware — config
+                                   # tpu_hist_f64
 ) -> jnp.ndarray:
     """Returns hist [num_slots, F, num_bins_padded, 3] f32 (sum_g, sum_h, count).
 
@@ -283,7 +303,7 @@ def build_histograms(
     n_rows, num_features = X.shape
     assert n_rows % chunk_rows == 0, (n_rows, chunk_rows)
     n_chunks = n_rows // chunk_rows
-    ch = NUM_CHANNELS if hilo else NUM_CHANNELS_FAST
+    ch = num_channels(hilo)
     compact = row_idx is not None
     iota_bins = jnp.arange(num_bins_padded, dtype=jnp.int32)[None, None, :]
     iota_slots = jnp.arange(num_slots, dtype=jnp.int32)[None, :]
@@ -296,7 +316,7 @@ def build_histograms(
             packed, _ = pack_rows(X, grad, hess, included, hilo, code_mode)
         ncb = code_bytes_total(num_features, code_mode)
 
-    def chunk_part(i, acc):
+    def chunk_part(i):
         sl = jax.lax.dynamic_slice_in_dim
         if compact:
             idx = sl(row_idx, i * chunk_rows, chunk_rows)
@@ -304,7 +324,7 @@ def build_histograms(
             valid = pos < n_active
             pk = jnp.take(packed, idx, axis=0)                    # [R, Wb] u8
             xc = unpack_codes(pk[:, :ncb], num_features, code_mode)
-            w = unpack_weights(pk[:, ncb:], ch)                   # [R, ch]
+            w = unpack_weights(pk[:, ncb:], ch, f32=(hilo == "f32"))  # [R, ch]
             if slot_cum is not None:
                 raw = slot_from_position(pos, slot_cum)
             else:
@@ -320,32 +340,55 @@ def build_histograms(
             w = weight_channels(gc, hc, mc, hilo)                  # [R, ch]
 
         slot_onehot = (slot[:, None] == iota_slots)               # [R, S] bool
-        rhs = (slot_onehot[:, :, None].astype(jnp.bfloat16) * w[:, None, :]
+        rhs = (slot_onehot[:, :, None].astype(w.dtype) * w[:, None, :]
                ).reshape(chunk_rows, num_slots * ch)              # [R, S*ch]
 
-        onehot = (xc.astype(jnp.int32)[:, :, None] == iota_bins).astype(jnp.bfloat16)  # [R, F, B]
+        onehot = (xc.astype(jnp.int32)[:, :, None] == iota_bins
+                  ).astype(w.dtype)                               # [R, F, B]
         part = jax.lax.dot_general(
             onehot, rhs,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            # f32 mode: HIGHEST decomposes each f32 operand into bf16
+            # triples, so every one-hot x weight product is EXACT (the
+            # one-hot side is 0/1); bf16 modes use the default fast path
+            precision=(jax.lax.Precision.HIGHEST if hilo == "f32" else None),
         )                                                         # [F, B, S*ch]
-        return acc + part
+        return part
 
     acc0 = jnp.zeros((num_features, num_bins_padded, num_slots * ch), jnp.float32)
+    if compensated:
+        # Kahan two-sum across chunk partials: the lost low-order bits of
+        # every f32 add are carried forward, so the accumulated bin sums are
+        # ~f64-accurate — the numerical effect of the reference's double
+        # HistogramBinEntry sums (bin.h:29-31) on f32-native hardware. XLA
+        # does not reassociate float arithmetic, so (t - acc) - y survives.
+        def accumulate(carry, i):
+            acc, comp = carry
+            y = chunk_part(i) - comp
+            t = acc + y
+            return t, (t - acc) - y
+    else:
+        def accumulate(carry, i):
+            acc, comp = carry
+            return acc + chunk_part(i), comp
+    comp0 = jnp.zeros_like(acc0) if compensated else jnp.zeros((), jnp.float32)
     if compact:
         n_chunks_active = jnp.minimum(
             (n_active + chunk_rows - 1) // chunk_rows, n_chunks)
 
         def while_body(carry):
-            i, acc = carry
-            return i + 1, chunk_part(i, acc)
+            i, acc, comp = carry
+            acc, comp = accumulate((acc, comp), i)
+            return i + 1, acc, comp
 
-        _, acc = jax.lax.while_loop(
+        _, acc, _ = jax.lax.while_loop(
             lambda c: c[0] < n_chunks_active, while_body,
-            (jnp.asarray(0, n_chunks_active.dtype), acc0))
+            (jnp.asarray(0, n_chunks_active.dtype), acc0, comp0))
     else:
-        acc, _ = jax.lax.scan(lambda a, i: (chunk_part(i, a), ()), acc0,
-                              jnp.arange(n_chunks))
+        (acc, _), _ = jax.lax.scan(
+            lambda c, i: (accumulate(c, i), ()), (acc0, comp0),
+            jnp.arange(n_chunks))
 
     acc = acc.reshape(num_features, num_bins_padded, num_slots, ch)
     acc = jnp.transpose(acc, (2, 0, 1, 3))                        # [S, F, B, ch]

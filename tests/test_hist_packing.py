@@ -66,3 +66,67 @@ def test_compacted_histogram_matches_full_pass(mode, max_code):
         slot_counts=counts, code_mode=mode)
     np.testing.assert_allclose(np.asarray(full), np.asarray(compact),
                                rtol=1e-5, atol=1e-4)
+
+
+def test_hist_f64_precision():
+    """tpu_hist_f64's build path (full-f32 weight columns at HIGHEST
+    precision + Kahan chunk carry) must land far closer to an exact NumPy
+    f64 histogram than the bf16 hi/lo default — the role of the reference's
+    double HistogramBinEntry bins (bin.h:29-31). Thresholds are ~3x above
+    measured (hilo ~1.6e-4, f64-mode ~5e-6 abs-vs-unit error, 34x apart)."""
+    rng = np.random.RandomState(0)
+    N, F, B, S = 1 << 16, 8, 64, 4
+    X = jnp.asarray(rng.randint(0, B, size=(N, F)).astype(np.uint8))
+    g = jnp.asarray(rng.randn(N).astype(np.float32))
+    h = jnp.asarray(np.abs(rng.randn(N)).astype(np.float32))
+    inc = jnp.asarray((rng.rand(N) < 0.9).astype(np.float32))
+    leaf = jnp.asarray(rng.randint(0, S, size=N), jnp.int32)
+    sol = jnp.arange(S, dtype=jnp.int32)
+
+    Xn, incn, ln = np.asarray(X), np.asarray(inc), np.asarray(leaf)
+    gw = np.asarray(g).astype(np.float64) * incn
+    hw = np.asarray(h).astype(np.float64) * incn
+    oracle = np.zeros((S, F, B, 3))
+    for c, w in ((0, gw), (1, hw), (2, incn.astype(np.float64))):
+        for f in range(F):
+            for s in range(S):
+                m = ln == s
+                oracle[s, f, :, c] = np.bincount(Xn[m, f], weights=w[m],
+                                                 minlength=B)
+
+    def err(**kw):
+        out = np.asarray(build_histograms(
+            X, g * inc, h * inc, inc, leaf, sol, num_slots=S,
+            num_bins_padded=B, chunk_rows=4096, **kw), np.float64)
+        return np.max(np.abs(out - oracle) / np.maximum(np.abs(oracle), 1.0))
+
+    e_hilo = err(hilo=True)
+    e_f64 = err(hilo="f32", compensated=True)
+    assert e_hilo < 1e-3, e_hilo
+    assert e_f64 < 2e-5, e_f64
+    assert e_f64 < e_hilo / 10, (e_f64, e_hilo)
+
+
+def test_hist_f64_compacted_matches_streaming():
+    """The f32 weight channels survive the packed-row byte round-trip: a
+    compacted f64-mode pass equals the streaming f64-mode pass exactly."""
+    rng = np.random.RandomState(4)
+    N, F, B, S = 2048, 6, 32, 4
+    X = jnp.asarray(rng.randint(0, B, size=(N, F)), jnp.uint8)
+    g = jnp.asarray(rng.randn(N), jnp.float32)
+    h = jnp.asarray(np.abs(rng.randn(N)), jnp.float32)
+    inc = jnp.ones(N, jnp.float32)
+    leaf_id = jnp.asarray(rng.randint(0, S, size=N), jnp.int32)
+    slot_of_leaf = jnp.arange(S + 1, dtype=jnp.int32).at[S].set(-1)
+
+    full = build_histograms(X, g, h, inc, leaf_id, slot_of_leaf,
+                            num_slots=S, num_bins_padded=B, chunk_rows=256,
+                            hilo="f32", compensated=True)
+    order = jnp.argsort(leaf_id, stable=True).astype(jnp.int32)
+    counts = jnp.bincount(leaf_id, length=S).astype(jnp.int32)
+    compact = build_histograms(
+        X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S, num_bins_padded=B,
+        chunk_rows=256, row_idx=order, n_active=jnp.asarray(N, jnp.int32),
+        slot_counts=counts, hilo="f32", compensated=True)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(compact),
+                               rtol=1e-6, atol=1e-5)

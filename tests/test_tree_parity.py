@@ -103,8 +103,12 @@ def test_categorical_trees_near_match_reference_engine():
     reference engine on synthetic data with a 12-category column
     (fixtures/cat_det.train, generation recipe in git history). Near-ties
     between candidate splits can flip under f32-vs-f64 histogram sums, so
-    the bar is: every decision TYPE identical, >=95% of nodes carry the
-    same split feature, and the root categorical bitset matches exactly."""
+    the bar is: every decision TYPE identical, EXACTLY the 2 known
+    near-tie split-feature flips (pinned so a regression cannot hide
+    inside a tolerance floor), and the root categorical bitset matches
+    exactly. tpu_hist_f64 tightens the bin sums ~30x
+    (test_hist_packing.py::test_hist_f64_precision) but the f32 split
+    scan still resolves these two specific ties its own way."""
     data = np.loadtxt(os.path.join(HERE, "fixtures", "cat_det.train"))
     X, y = data[:, 1:], data[:, 0]
     params = dict(BASE, objective="binary")
@@ -135,7 +139,7 @@ def test_categorical_trees_near_match_reference_engine():
         for rf, of in zip(rt["f"], ot["f"]):
             total += 1
             feat_ok += rf == of
-    assert feat_ok / total >= 0.95, f"{feat_ok}/{total}"
+    assert feat_ok == total - 2, f"{feat_ok}/{total} (expected exactly 68/70)"
     assert ref[0]["ct"] == our[0]["ct"], "root categorical bitset differs"
 
 
@@ -145,9 +149,10 @@ def test_missing_value_trees_match_reference_engine():
     directions, feature_histogram.hpp:314-350): on data with 30%/15% NaN
     columns (fixtures/nan_det.train) every split feature matches the
     reference engine; decision-type bytes (missing type + default_left) may
-    differ on a few nodes where both scan directions tie — the bar is all
-    features, >=95% thresholds, >=90% decision types, and tree 0's
-    decision types exact."""
+    differ where both scan directions tie — the bar pins the EXACT known
+    counts (1 threshold + 3 decision-byte near-tie flips) so a regression
+    cannot hide inside a tolerance floor, and tree 0's decision types are
+    exact."""
     data = np.genfromtxt(os.path.join(HERE, "fixtures", "nan_det.train"))
     X, y = data[:, 1:], data[:, 0]
     bst = lgb.train(dict(BASE, objective="binary", use_missing=True),
@@ -173,5 +178,5 @@ def test_missing_value_trees_match_reference_engine():
             thr_ok += abs(float(rt["t"][k]) - float(ot["t"][k])) < 1e-9
             d_ok += rd[k] == od[k]
     assert feat_ok == total, f"features: {feat_ok}/{total}"
-    assert thr_ok / total >= 0.95, f"thresholds: {thr_ok}/{total}"
-    assert d_ok / total >= 0.90, f"decision types: {d_ok}/{total}"
+    assert thr_ok == total - 1, f"thresholds: {thr_ok}/{total} (expected 69/70)"
+    assert d_ok == total - 3, f"decision types: {d_ok}/{total} (expected 67/70)"
