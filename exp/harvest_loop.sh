@@ -1,0 +1,11 @@
+#!/bin/bash
+# Keeps exactly one harvest_window.py alive: the harvester blocks inside
+# backend init until the axon tunnel answers, banks every measurement it
+# can, and exits; this loop immediately arms the next one.
+# Run: nohup bash exp/harvest_loop.sh > exp/harvest_loop.log 2>&1 &
+cd "$(dirname "$0")/.."
+while true; do
+  python -u exp/harvest_window.py
+  echo "$(date -u +%H:%M:%S) harvester exited rc=$? — rearming in 30s"
+  sleep 30
+done

@@ -1,84 +1,127 @@
 """ON-CHIP equality check: Pallas histogram kernel vs the XLA one-hot
 matmul reference path, on the real TPU backend (tests/test_pallas_hist.py
 runs the same comparison but under the hermetic-CPU conftest in interpret
-mode — this script is the hardware gate for flipping tpu_hist_kernel=auto
-back to pallas; the analog of the reference's GPU_DEBUG_COMPARE,
+mode — this script is the hardware gate for tpu_hist_kernel=auto resolving
+to pallas; the analog of the reference's GPU_DEBUG_COMPARE,
 gpu_tree_learner.cpp:1018-1043).
 
+On success it writes the marker file read by
+lightgbm_tpu.utils.cache.pallas_validated_on_chip(), which is what flips
+``auto`` from the XLA fallback to the Pallas kernel for every subsequent
+process on this machine (including the driver's end-of-round bench run).
+
 Run: python -u exp/pallas_onchip_check.py
+Importable: run_gate() -> int (failure count; 0 writes the marker).
 """
+import datetime
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import numpy as np
-import jax
-import jax.numpy as jnp
 
-from lightgbm_tpu.utils.cache import enable_compile_cache, repo_cache_dir
-enable_compile_cache(repo_cache_dir())
 
-from lightgbm_tpu.ops.histogram import build_histograms, pack_rows
-from lightgbm_tpu.ops import pallas_histogram as ph
-from lightgbm_tpu.ops.pallas_histogram import build_histograms_pallas
+def run_gate(write_marker=True):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
 
-print("backend:", jax.default_backend(), jax.devices()[0])
-if jax.default_backend() == "cpu":
-    # smoke-run bed only; the real gate needs Mosaic on hardware
-    print("NOTE: cpu backend -> interpret mode (NOT the hardware gate)")
-    ph._INTERPRET = True
+    from lightgbm_tpu.utils.cache import (
+        _libtpu_version, enable_compile_cache, pallas_gate_marker_path,
+        pallas_kernel_source_hash, repo_cache_dir)
+    enable_compile_cache(repo_cache_dir())
 
-rng = np.random.RandomState(0)
-failures = 0
-# LGBM_TPU_CHECK_SCALE=small shrinks rows for an interpret-mode smoke
-scale = 4096 if os.environ.get("LGBM_TPU_CHECK_SCALE") == "small" else 1 << 17
-for name, N, F, B, S, dtype, maxc in [
-        ("u8 B=256", scale, 28, 256, 16, np.uint8, 256),
-        ("u8 B=64", scale, 28, 64, 25, np.uint8, 64),
-        ("u16 B=512", scale // 2, 12, 512, 8, np.uint16, 512),
-]:
-    X = jnp.asarray(rng.randint(0, maxc, size=(N, F)).astype(dtype))
-    g = jnp.asarray(rng.randn(N).astype(np.float32))
-    h = jnp.asarray(np.abs(rng.randn(N)).astype(np.float32))
-    inc = jnp.asarray((rng.rand(N) < 0.9).astype(np.float32))
-    leaf_id = jnp.asarray(rng.randint(0, S + 3, size=N), jnp.int32)
-    slot_of_leaf = jnp.concatenate([
-        jnp.arange(S, dtype=jnp.int32),
-        jnp.full(3, -1, jnp.int32)])
+    from lightgbm_tpu.ops.histogram import build_histograms, pack_rows
+    from lightgbm_tpu.ops import pallas_histogram as ph
+    from lightgbm_tpu.ops.pallas_histogram import build_histograms_pallas
 
-    ref = np.asarray(build_histograms(
-        X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S,
-        num_bins_padded=B, chunk_rows=2048))
-    for compact in (False, True):
-        kw = {}
-        if compact:
-            order = jnp.argsort(
-                jnp.where(slot_of_leaf[leaf_id] >= 0,
-                          slot_of_leaf[leaf_id], jnp.int32(2 ** 30)),
-                stable=True).astype(jnp.int32)
-            counts = jnp.bincount(
-                jnp.where(slot_of_leaf[leaf_id] >= 0, slot_of_leaf[leaf_id], S),
-                length=S + 1)[:S].astype(jnp.int32)
-            n_act = jnp.sum((slot_of_leaf[leaf_id] >= 0).astype(jnp.int32))
-            packed, _ = pack_rows(X, g, h, inc, True)
-            kw = dict(row_idx=order, n_active=n_act, slot_counts=counts,
-                      packed=packed, max_rows=N)
-        try:
-            out = np.asarray(build_histograms_pallas(
-                X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S,
-                num_bins_padded=B, chunk_rows=512, **kw))
-        except Exception as e:                            # noqa: BLE001
-            print(f"FAIL {name} compact={compact}: {str(e)[:300]}")
-            failures += 1
-            continue
-        # f32 sums accumulated in different orders: tolerate tiny drift
-        err = np.max(np.abs(out - ref))
-        rel = err / max(np.max(np.abs(ref)), 1.0)
-        ok = rel < 1e-5
-        print(f"{'OK  ' if ok else 'FAIL'} {name} compact={compact}: "
-              f"max_abs_err={err:.3e} rel={rel:.3e}")
-        failures += 0 if ok else 1
+    print("backend:", jax.default_backend(), jax.devices()[0], flush=True)
+    on_hardware = jax.default_backend() == "tpu"
+    if not on_hardware:
+        # smoke-run bed only; the real gate needs Mosaic on hardware
+        print("NOTE: cpu backend -> interpret mode (NOT the hardware gate)")
+        ph._INTERPRET = True
 
-print("PALLAS ON-CHIP:", "ALL OK — safe to flip auto->pallas"
-      if failures == 0 else f"{failures} FAILURES — keep auto=xla")
-sys.exit(1 if failures else 0)
+    rng = np.random.RandomState(0)
+    failures = 0
+    worst_rel = 0.0
+    # LGBM_TPU_CHECK_SCALE=small shrinks rows for an interpret-mode smoke
+    scale = 4096 if os.environ.get("LGBM_TPU_CHECK_SCALE") == "small" \
+        else 1 << 17
+    for name, N, F, B, S, dtype, maxc in [
+            ("u8 B=256", scale, 28, 256, 16, np.uint8, 256),
+            ("u8 B=64", scale, 28, 64, 25, np.uint8, 64),
+            ("u16 B=512", scale // 2, 12, 512, 8, np.uint16, 512),
+    ]:
+        X = jnp.asarray(rng.randint(0, maxc, size=(N, F)).astype(dtype))
+        g = jnp.asarray(rng.randn(N).astype(np.float32))
+        h = jnp.asarray(np.abs(rng.randn(N)).astype(np.float32))
+        inc = jnp.asarray((rng.rand(N) < 0.9).astype(np.float32))
+        leaf_id = jnp.asarray(rng.randint(0, S + 3, size=N), jnp.int32)
+        slot_of_leaf = jnp.concatenate([
+            jnp.arange(S, dtype=jnp.int32),
+            jnp.full(3, -1, jnp.int32)])
+
+        ref = np.asarray(build_histograms(
+            X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S,
+            num_bins_padded=B, chunk_rows=2048))
+        for compact in (False, True):
+            kw = {}
+            if compact:
+                order = jnp.argsort(
+                    jnp.where(slot_of_leaf[leaf_id] >= 0,
+                              slot_of_leaf[leaf_id], jnp.int32(2 ** 30)),
+                    stable=True).astype(jnp.int32)
+                counts = jnp.bincount(
+                    jnp.where(slot_of_leaf[leaf_id] >= 0,
+                              slot_of_leaf[leaf_id], S),
+                    length=S + 1)[:S].astype(jnp.int32)
+                n_act = jnp.sum((slot_of_leaf[leaf_id] >= 0).astype(
+                    jnp.int32))
+                packed, _ = pack_rows(X, g, h, inc, True)
+                kw = dict(row_idx=order, n_active=n_act, slot_counts=counts,
+                          packed=packed, max_rows=N)
+            try:
+                out = np.asarray(build_histograms_pallas(
+                    X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S,
+                    num_bins_padded=B, chunk_rows=512, **kw))
+            except Exception as e:                        # noqa: BLE001
+                print(f"FAIL {name} compact={compact}: {str(e)[:300]}",
+                      flush=True)
+                failures += 1
+                continue
+            # f32 sums accumulated in different orders: tolerate tiny drift
+            err = np.max(np.abs(out - ref))
+            rel = err / max(np.max(np.abs(ref)), 1.0)
+            ok = rel < 1e-5
+            worst_rel = max(worst_rel, float(rel))
+            print(f"{'OK  ' if ok else 'FAIL'} {name} compact={compact}: "
+                  f"max_abs_err={err:.3e} rel={rel:.3e}", flush=True)
+            failures += 0 if ok else 1
+
+    print("PALLAS ON-CHIP:", "ALL OK — auto resolves to pallas"
+          if failures == 0 else f"{failures} FAILURES — auto stays xla")
+    marker = pallas_gate_marker_path()
+    if failures and on_hardware and os.path.exists(marker):
+        # a marker from an older (passing) libtpu must not outlive a
+        # failing re-run — that is exactly the hazard the gate exists for
+        os.remove(marker)
+        print("stale marker removed:", marker)
+    if failures == 0 and on_hardware and write_marker:
+        with open(marker + ".tmp", "w") as fh:
+            json.dump({
+                "device": str(jax.devices()[0]),
+                "jax": jax.__version__,
+                "libtpu": _libtpu_version(),
+                "kernel_src": pallas_kernel_source_hash(),
+                "worst_rel_err": worst_rel,
+                "utc": datetime.datetime.utcnow().isoformat(
+                    timespec="seconds"),
+            }, fh)
+        os.replace(marker + ".tmp", marker)
+        print("marker written:", marker)
+    return failures
+
+
+if __name__ == "__main__":
+    sys.exit(1 if run_gate() else 0)

@@ -155,14 +155,19 @@ class GBDT:
         n_for_pad = N if self._block_counts is None else \
             max(self._block_counts) * len(self._block_counts)
         per_target = max((n_for_pad + Drow - 1) // Drow, 1)
-        # "auto" kernel: the XLA one-hot matmul everywhere until the Pallas
-        # VMEM-accumulator kernel has passed its equality check on real
-        # hardware (this round's packed-u8/strided-unpack changes were only
-        # interpret-mode validated; Mosaic lowering can differ on libtpu).
-        # Opt in explicitly with tpu_hist_kernel=pallas.
+        # "auto" kernel: the Pallas VMEM-accumulator kernel once it has
+        # passed its equality check on real hardware (the on-chip gate,
+        # exp/pallas_onchip_check.py, writes a marker checked by
+        # pallas_validated_on_chip — the analog of the reference's
+        # GPU_DEBUG_COMPARE, gpu_tree_learner.cpp:1018-1043); the XLA
+        # one-hot matmul otherwise (CPU backends, or un-gated libtpu —
+        # Mosaic lowering can differ from interpret mode). Opt in/out
+        # explicitly with tpu_hist_kernel=pallas|xla.
         hist_kernel = config.tpu_hist_kernel
         if hist_kernel == "auto":
-            hist_kernel = "xla"
+            from ..utils.cache import pallas_validated_on_chip
+            hist_kernel = ("pallas" if pallas_validated_on_chip()
+                           else "xla")
             Log.debug("tpu_hist_kernel=auto resolved to %s", hist_kernel)
         if config.tpu_hist_f64 and hist_kernel == "pallas":
             Log.warning("tpu_hist_f64 requires the xla histogram kernel; "

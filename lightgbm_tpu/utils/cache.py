@@ -22,3 +22,76 @@ def repo_cache_dir() -> str:
     return os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))), ".jax_cache")
+
+
+def pallas_gate_marker_path() -> str:
+    """Marker written by exp/pallas_onchip_check.py when the Pallas
+    histogram kernel passes its equality gate on real TPU hardware."""
+    return os.path.join(os.path.dirname(repo_cache_dir()),
+                        ".pallas_onchip_ok.json")
+
+
+def _libtpu_version() -> str:
+    """Best-effort libtpu version (Mosaic lowering lives there)."""
+    try:
+        import importlib.metadata
+        for name in ("libtpu", "libtpu-nightly"):
+            try:
+                return importlib.metadata.version(name)
+            except importlib.metadata.PackageNotFoundError:
+                continue
+    except Exception:
+        pass
+    return "unknown"
+
+
+def pallas_kernel_source_hash() -> str:
+    """md5 over the histogram-kernel sources: a marker earned under old
+    kernel code must not bless later, hardware-unvalidated edits (same
+    pattern as bench.py keying its dataset cache on the binning sources)."""
+    import hashlib
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.md5()
+    for rel in ("ops/pallas_histogram.py", "ops/histogram.py"):
+        try:
+            with open(os.path.join(root, rel), "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            h.update(b"missing:" + rel.encode())
+    return h.hexdigest()
+
+
+def pallas_validated_on_chip() -> bool:
+    """True iff the current backend is a real TPU AND the on-chip Pallas
+    equality gate has passed on this machine (the marker file exists).
+
+    This is how ``tpu_hist_kernel=auto`` decides between the Pallas
+    VMEM-accumulator kernel and the XLA one-hot-matmul fallback: the
+    kernel is equality-tested in interpret mode on every CI run, but
+    Mosaic lowering on a particular libtpu is only trusted after the
+    hardware gate has actually executed there — the same role as the
+    reference's GPU_DEBUG_COMPARE self-check
+    (gpu_tree_learner.cpp:1018-1043) played for its OpenCL kernels.
+
+    The marker records the jax version it was earned under; a runtime
+    upgrade invalidates it (Mosaic lowering differences across libtpu
+    versions are the exact failure the gate guards against).
+    """
+    try:
+        import json
+
+        import jax
+        if jax.default_backend() != "tpu":
+            return False
+        path = pallas_gate_marker_path()
+        if not os.path.exists(path):
+            return False
+        with open(path) as fh:
+            meta = json.load(fh)
+        # every pin must be present and match: jax, libtpu (Mosaic lives
+        # there), and the kernel sources the gate actually executed
+        return (meta.get("jax") == jax.__version__
+                and meta.get("libtpu") == _libtpu_version()
+                and meta.get("kernel_src") == pallas_kernel_source_hash())
+    except Exception:
+        return False

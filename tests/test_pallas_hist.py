@@ -217,3 +217,34 @@ def test_max_rows_capped_buffers_match():
                                rtol=1e-5, atol=1e-4)
     np.testing.assert_array_equal(np.asarray(capped[..., 2]),
                                   np.asarray(ref[..., 2]))
+
+
+def test_auto_kernel_gated_by_onchip_marker(monkeypatch, tmp_path):
+    """tpu_hist_kernel=auto resolves to pallas ONLY when the on-chip gate
+    marker exists AND the backend is a real TPU (utils/cache.py
+    pallas_validated_on_chip) — the runtime analog of the reference gating
+    its GPU learner on GPU_DEBUG_COMPARE passing."""
+    import json
+
+    import jax
+
+    from lightgbm_tpu.utils import cache
+
+    marker = tmp_path / "ok.json"
+    monkeypatch.setattr(cache, "pallas_gate_marker_path",
+                        lambda: str(marker))
+    pins = {"jax": jax.__version__, "libtpu": cache._libtpu_version(),
+            "kernel_src": cache.pallas_kernel_source_hash()}
+    # CPU backend: auto stays xla even with the marker present
+    marker.write_text(json.dumps(pins))
+    assert not cache.pallas_validated_on_chip()
+    # simulate a TPU backend: marker decides
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert cache.pallas_validated_on_chip()
+    # stale under a different jax, a different libtpu, or edited kernel code
+    for bad in ({"jax": "0.0.0-other"}, {"libtpu": "other"},
+                {"kernel_src": "beef"}):
+        marker.write_text(json.dumps({**pins, **bad}))
+        assert not cache.pallas_validated_on_chip(), bad
+    marker.unlink()
+    assert not cache.pallas_validated_on_chip()
