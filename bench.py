@@ -349,9 +349,11 @@ def run_bench(deadline, attempt=0, platform=None):
             if os.path.exists(qbin):
                 dq = lgb.Dataset(qbin)
             else:
-                dq = lgb.Dataset(np.asarray(X[:quick_rows]),
-                                 label=np.asarray(y[:quick_rows]),
-                                 params=params)
+                # standalone gen, NOT a slice of the big matrix: the same
+                # qbin file is also built by exp/harvest_window.py and the
+                # cache pre-builder, and all writers must agree on content
+                Xq, yq = _higgs_like(quick_rows)
+                dq = lgb.Dataset(Xq, label=yq, params=params)
                 dq.construct()
                 dq.save_binary(qbin + ".tmp")
                 os.replace(qbin + ".tmp", qbin)
@@ -616,7 +618,11 @@ def run_bench(deadline, attempt=0, platform=None):
 
 
 def main():
-    budget = int(os.environ.get("LGBM_TPU_BENCH_TIMEOUT", "540"))
+    # default sized for a LIVE tunnel with cold remote compiles: quick
+    # pre-bank (~5 min incl. compile) always fits and is printed if the
+    # 10.5M phase can't finish in the remainder. Dead tunnel still exits
+    # in ~4.5 min (fast-fail probe + hermetic-CPU fallback).
+    budget = int(os.environ.get("LGBM_TPU_BENCH_TIMEOUT", "900"))
     t_start = time.time()
 
     def deadline():
