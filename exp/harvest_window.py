@@ -46,7 +46,8 @@ os.environ.setdefault("LGBM_TPU_BENCH_QUICK", "0")    # quick is its own phase
 
 _PHASE = {"name": "init", "t0": time.time(), "limit": None}
 _LIMITS = {"quick": 2400, "gate": 2400, "quick_pallas": 1200,
-           "full": 4500, "slots51": 1500, "sparse": 1800, "full_xla": 2700}
+           "full": 4500, "slots51": 1500, "sparse": 1800, "full_xla": 2700,
+           "phase_a": 2400, "wave_profile": 3000}
 
 
 def _status(msg):
@@ -204,9 +205,11 @@ def main():
                    os.path.join(REPO, "exp", "BENCH_local_r5.json"))
     except Exception as e:                                   # noqa: BLE001
         traceback.print_exc()
+        # the snapshot is the 2.1M quick pre-bank, NOT a full-scale
+        # result — label it so downstream consumers can't promote it
         part = dict(bench._PARTIAL.get("result") or {})
         part["error"] = f"{type(e).__name__}: {e}"[:300]
-        _bank("full", part)
+        _bank("full_partial", part)
 
     # ---- 5. slots=51 sweep at quick scale -----------------------------
     _enter("slots51")
@@ -241,6 +244,25 @@ def main():
         except Exception as e:                               # noqa: BLE001
             traceback.print_exc()
             _bank("full_xla", {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    # ---- 8. profiler scripts: the measured per-wave breakdown ---------
+    # (VERDICT r4 #3's deliverable — exp/RESULTS.md gets its round-5
+    # table from these logs)
+    for phase, script in (("phase_a", "phase_a_check.py"),
+                          ("wave_profile", "wave_profile.py")):
+        _enter(phase)
+        log_path = os.path.join(REPO, "exp", f"{phase}_r5.log")
+        try:
+            spec = importlib.util.spec_from_file_location(
+                phase, os.path.join(REPO, "exp", script))
+            mod = importlib.util.module_from_spec(spec)
+            with open(log_path, "w") as fh, redirect_stdout(fh):
+                spec.loader.exec_module(mod)
+            _bank(phase, {"log": log_path, "phase_s": _phase_time()})
+        except Exception as e:                               # noqa: BLE001
+            traceback.print_exc()
+            _bank(phase, {"error": f"{type(e).__name__}: {e}"[:300],
+                          "log": log_path})
 
     _status("harvest complete — exiting 0")
 

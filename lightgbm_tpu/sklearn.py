@@ -118,11 +118,118 @@ class LGBMModel(_SKBase):
         params.update(self._other_params)
         return params
 
+    # ---- input validation (sklearn estimator-check contract) -----------
+
+    def _validate_fit_inputs(self, X, y):
+        """Shape/finiteness checks with sklearn's expected error phrasing
+        (check_estimator: fit1d, inconsistent lengths, empty data, complex
+        data, y None, y NaN/inf, 2-D column-vector y warning). X NaN is
+        ALLOWED — missing values are a modeled feature (tags allow_nan)."""
+        if y is None:
+            raise ValueError(
+                f"This {type(self).__name__} estimator requires y to be "
+                "passed, but the target y is None.")
+        shape = getattr(X, "shape", None)
+        if shape is None:
+            X = np.asarray(X)
+            shape = X.shape
+        # complex check only on dtype-bearing containers: sklearn's
+        # not-an-array inputs refuse __array_function__ dispatch
+        x_cplx = getattr(X, "dtype", None) is not None and np.iscomplexobj(X)
+        y_cplx = getattr(y, "dtype", None) is not None and np.iscomplexobj(y)
+        if x_cplx or y_cplx:
+            raise ValueError("Complex data not supported")
+        if len(shape) != 2:
+            raise ValueError(
+                f"Expected 2D array, got {len(shape)}D array instead. "
+                "Reshape your data either using array.reshape(-1, 1) or "
+                "array.reshape(1, -1).")
+        n_samples, n_feat = int(shape[0]), int(shape[1])
+        if n_samples == 0:
+            raise ValueError(
+                f"Found array with 0 sample(s) (shape={tuple(shape)}) while "
+                "a minimum of 1 is required.")
+        if n_feat == 0:
+            raise ValueError(
+                f"Found array with 0 feature(s) (shape={tuple(shape)}) "
+                "while a minimum of 1 is required.")
+        if n_samples < 2:
+            raise ValueError(
+                f"Found array with {n_samples} sample(s) while a minimum "
+                "of 2 is required: histogram split finding needs at least "
+                "two rows.")
+        y = np.asarray(y)
+        if y.ndim == 2 and y.shape[1] == 1:
+            import warnings
+            try:
+                from sklearn.exceptions import DataConversionWarning
+            except ImportError:                       # pragma: no cover
+                DataConversionWarning = UserWarning
+            warnings.warn(
+                "A column-vector y was passed when a 1d array was "
+                "expected. Please change the shape of y to "
+                "(n_samples,), for example using ravel().",
+                DataConversionWarning)
+            y = y.ravel()
+        if y.ndim != 1:
+            raise ValueError(f"y must be 1d, got shape {y.shape}")
+        if y.shape[0] != n_samples:
+            raise ValueError(
+                "Found input variables with inconsistent numbers of "
+                f"samples: [{n_samples}, {y.shape[0]}]")
+        if np.issubdtype(y.dtype, np.floating) and \
+                not np.isfinite(y).all():
+            raise ValueError(
+                "Input y contains NaN or infinity; supervised targets "
+                "must be finite.")
+        return X, y, n_feat
+
+    def _validate_predict_input(self, X) -> int:
+        """Fitted/shape/width checks; returns X's row count."""
+        if self._Booster is None and \
+                getattr(self, "_single_class", None) is None:
+            try:
+                from sklearn.exceptions import NotFittedError
+            except ImportError:                       # pragma: no cover
+                NotFittedError = ValueError
+            raise NotFittedError(
+                f"This {type(self).__name__} instance is not fitted yet. "
+                "Call 'fit' with appropriate arguments before using this "
+                "estimator.")
+        shape = getattr(X, "shape", None)
+        if shape is None:
+            # np.asarray goes through __array__, which sklearn's
+            # not-an-array test containers allow (np.shape does not)
+            shape = np.asarray(X).shape
+        if len(shape) != 2:
+            raise ValueError(
+                f"Expected 2D array, got {len(shape)}D array instead. "
+                "Reshape your data either using array.reshape(-1, 1) or "
+                "array.reshape(1, -1).")
+        if self._n_features is not None and int(shape[1]) != self._n_features:
+            raise ValueError(
+                f"X has {int(shape[1])} features, but "
+                f"{type(self).__name__} is expecting {self._n_features} "
+                "features as input.")
+        return int(shape[0])
+
+    def __sklearn_tags__(self):                       # sklearn >= 1.6
+        tags = super().__sklearn_tags__()
+        tags.input_tags.sparse = True      # CSR/CSC ingested natively
+        tags.input_tags.allow_nan = True   # NaN in X = missing values
+        return tags
+
     def fit(self, X, y, sample_weight=None, init_score=None, group=None,
             eval_set=None, eval_names=None, eval_sample_weight=None,
             eval_init_score=None, eval_group=None, eval_metric=None,
             early_stopping_rounds=None, verbose=False, feature_name="auto",
             categorical_feature="auto", callbacks=None):
+        if getattr(self, "_fit_prevalidated", False):
+            # LGBMClassifier.fit already validated and label-encoded
+            self._fit_prevalidated = False
+        else:
+            X, y, n_feat = self._validate_fit_inputs(X, y)
+            self.n_features_in_ = n_feat
         params = self._lgb_params()
         # callable objective: the reference sklearn wrapper accepts
         # objective(y_true, y_pred) -> (grad, hess) and routes it as a
@@ -179,6 +286,7 @@ class LGBMModel(_SKBase):
 
     def predict(self, X, raw_score: bool = False, num_iteration: Optional[int] = None,
                 pred_leaf: bool = False, pred_contrib: bool = False, **kwargs):
+        self._validate_predict_input(X)
         return self._Booster.predict(X, raw_score=raw_score,
                                      num_iteration=num_iteration,
                                      pred_leaf=pred_leaf, pred_contrib=pred_contrib)
@@ -211,10 +319,41 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         super().__init__(**kwargs)
 
     def fit(self, X, y, **kwargs):
-        y = np.asarray(y)
+        # base-class shape/None/NaN validation FIRST — the label encoding
+        # below would otherwise turn malformed y into confusing errors
+        X, y, n_feat = self._validate_fit_inputs(X, y)
+        if np.issubdtype(y.dtype, np.floating) and \
+                not np.array_equal(y, np.round(y)):
+            raise ValueError(
+                f"Unknown label type: continuous targets are not supported "
+                "by classifiers; use LGBMRegressor for regression.")
         self._classes = np.unique(y)
         self._n_classes = len(self._classes)
         self._label_map = {c: i for i, c in enumerate(self._classes)}
+        # classes that still carry training signal after sample_weight
+        # zeroing (sklearn contract: a problem reduced to one class must
+        # predict that class; the reference core faithfully emits no trees
+        # there — gbdt.cpp:438-448 contributes nothing for 1-leaf trees —
+        # so the constant-class answer lives in the wrapper)
+        effective = self._classes
+        sw = kwargs.get("sample_weight")
+        if sw is not None:
+            sw = np.asarray(sw, dtype=np.float64)
+            effective = np.asarray(
+                [c for c in self._classes if np.any((y == c) & (sw > 0))])
+        if len(effective) < 2:
+            self.n_features_in_ = n_feat
+            self._n_features = n_feat
+            self._Booster = None
+            self._single_class = (effective[0] if len(effective)
+                                  else self._classes[0])
+            self._used_custom_obj = False
+            self.evals_result_ = {}
+            self.best_iteration_ = 0
+            return self
+        self._single_class = None
+        self.n_features_in_ = n_feat
+        self._fit_prevalidated = True
         y_enc = np.asarray([self._label_map[v] for v in y], dtype=np.float64)
         if self._n_classes > 2:
             self._objective = self.objective or "multiclass"
@@ -224,6 +363,12 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         return super().fit(X, y_enc, **kwargs)
 
     def predict_proba(self, X, raw_score=False, num_iteration=None, **kwargs):
+        n_rows = self._validate_predict_input(X)
+        if getattr(self, "_single_class", None) is not None:
+            proba = np.zeros((n_rows, max(self._n_classes, 1)))
+            proba[:, int(np.searchsorted(self._classes,
+                                         self._single_class))] = 1.0
+            return proba
         result = self._Booster.predict(X, raw_score=raw_score,
                                        num_iteration=num_iteration)
         if getattr(self, "_used_custom_obj", False) and not raw_score:
@@ -241,6 +386,9 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         return result
 
     def predict(self, X, raw_score=False, num_iteration=None, **kwargs):
+        if getattr(self, "_single_class", None) is not None:
+            n_rows = self._validate_predict_input(X)
+            return np.full(n_rows, self._single_class)
         if raw_score:
             return self._Booster.predict(X, raw_score=True, num_iteration=num_iteration)
         proba = self.predict_proba(X, num_iteration=num_iteration)

@@ -80,9 +80,29 @@ else:
         params["top_k"] = 5
     ds = lgb.Dataset(X, label=y)
 bst = lgb.train(params, ds, num_boost_round=5,
-                keep_training_booster=(mode == "prepart_efb"))
+                keep_training_booster=(mode in ("prepart", "prepart_efb")))
 if mode == "prepart_efb":
     assert bst._gbdt.bundle is not None, "EFB must engage under pre-partition"
+if mode == "prepart":
+    # C-API LGBM_BoosterGetPredict under is_pre_partition must select the
+    # real rows out of the block-padded device layout (_real_rows, ADVICE
+    # r4 #2) in global block order — compare against host-tree predictions
+    # of the full matrix. _fetch allgathers across processes, so BOTH
+    # ranks make the same calls.
+    import ctypes
+
+    from lightgbm_tpu import capi_impl
+
+    h = capi_impl._register(bst)
+    n_pred = capi_impl.booster_get_num_predict(h, 0)
+    assert n_pred == 4000, n_pred
+    buf = (ctypes.c_double * n_pred)()
+    n_out = capi_impl.booster_get_predict(h, 0, ctypes.addressof(buf))
+    got = np.frombuffer(buf, dtype=np.float64, count=n_out)
+    want = bst.predict(X)              # global rows, block order = original
+    assert np.allclose(got, want, rtol=1e-4, atol=1e-5), \
+        float(np.abs(got - want).max())
+    print(f"rank {rank} capi get_predict prepart OK", flush=True)
 
 import jax
 assert jax.process_count() == 2, jax.process_count()

@@ -138,3 +138,24 @@ def test_value_to_bin_boundary_semantics():
     m.is_trivial = False
     bins = m.value_to_bin(np.array([0.5, 1.0, 1.5, 2.0, 2.5, 100.0]))
     assert list(bins) == [0, 0, 1, 1, 2, 3]
+
+
+def test_collect_distinct_interior_zero_splice_unguarded():
+    """A fully-dense column crossing negative->positive still gets a
+    (0.0, 0) distinct entry: the reference's interior splice
+    (bin.cpp:245-248) is unguarded, unlike the all-positive/all-negative
+    edge splices which only fire when zeros exist (ADVICE r4 #1)."""
+    from lightgbm_tpu.binning import BinMapper
+
+    vals = np.array([-2.0, -1.0, 1.0, 2.0], dtype=np.float64)
+    uniq, cnts = BinMapper._collect_distinct(vals, zero_cnt=0)
+    zi = np.searchsorted(uniq, 0.0)
+    assert uniq[zi] == 0.0 and cnts[zi] == 0
+    # edge splices stay guarded: all-positive with no zeros -> no 0 entry
+    uniq2, _ = BinMapper._collect_distinct(
+        np.array([1.0, 2.0], dtype=np.float64), zero_cnt=0)
+    assert 0.0 not in uniq2
+    # and with zeros they fire
+    uniq3, cnts3 = BinMapper._collect_distinct(
+        np.array([1.0, 2.0], dtype=np.float64), zero_cnt=5)
+    assert uniq3[0] == 0.0 and cnts3[0] == 5
