@@ -4,14 +4,14 @@ backed by the TPU pipeline instead of ctypes into lib_lightgbm.so.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Union
 
 import numpy as np
 
 from .config import Config
 from .dataset import ConstructedDataset, Metadata, construct_dataset
 from .tree import Tree
-from .utils.log import Log, LightGBMError
+from .utils.log import Log
 
 
 def _is_sparse(data) -> bool:

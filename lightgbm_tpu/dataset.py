@@ -15,7 +15,7 @@ kept only for config compatibility. Per-feature bin counts stay variable;
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np

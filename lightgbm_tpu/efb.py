@@ -34,7 +34,6 @@ from typing import List, Optional
 
 import numpy as np
 
-from .utils.log import Log
 
 _MAX_SEARCH_GROUPS = 100          # reference max_search_group (dataset.cpp:75)
 _SAMPLE_ROWS = 100_000

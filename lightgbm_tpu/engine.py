@@ -2,7 +2,6 @@
 from __future__ import annotations
 
 import collections
-import copy
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np

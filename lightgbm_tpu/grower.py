@@ -31,7 +31,6 @@ call sites, but as XLA collectives inside the same while_loop.
 """
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Dict, NamedTuple, Optional, Tuple
 

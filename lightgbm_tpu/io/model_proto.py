@@ -12,7 +12,6 @@ from typing import Optional
 import numpy as np
 
 from ..tree import Tree
-from ..utils.log import Log
 from . import model_pb2
 from .model_text import _feature_infos, _objective_string
 

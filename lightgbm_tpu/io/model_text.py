@@ -13,7 +13,6 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..tree import Tree
-from ..utils.log import Log
 
 
 def _fmt_double(v: float) -> str:

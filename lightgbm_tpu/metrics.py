@@ -13,7 +13,7 @@ Each metric returns a list of (name, value, is_higher_better).
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 

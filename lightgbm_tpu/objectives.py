@@ -16,7 +16,7 @@ flattening (multiclass_objective.hpp:60-75) as a 2-D array.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,7 @@ the host finalize converts masks to raw-category bitsets.
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

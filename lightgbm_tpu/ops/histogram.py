@@ -23,7 +23,6 @@ accuracy deltas: docs/GPU-Performance.rst:131-133).
 """
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax

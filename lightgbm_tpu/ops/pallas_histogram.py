@@ -26,7 +26,7 @@ accepted small deltas, docs/GPU-Performance.rst:131-133). Counts are exact
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -38,7 +38,7 @@ sorted-prefix modes, feature_histogram.hpp:104-259), which produces the same
 """
 from __future__ import annotations
 
-from typing import Dict, NamedTuple, Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp

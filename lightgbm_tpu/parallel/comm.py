@@ -26,9 +26,8 @@ name; `grow_tree` (grower.py) calls them at trace time inside `shard_map`.
 """
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
-from typing import Callable, NamedTuple, Optional, Tuple
+from typing import Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,6 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .basic import Booster
-from .utils.log import LightGBMError
 
 
 def _check_matplotlib():

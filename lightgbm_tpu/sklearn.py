@@ -1,7 +1,7 @@
 """scikit-learn API wrappers (reference: python-package/lightgbm/sklearn.py:137-770)."""
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -354,7 +354,36 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         self._single_class = None
         self.n_features_in_ = n_feat
         self._fit_prevalidated = True
-        y_enc = np.asarray([self._label_map[v] for v in y], dtype=np.float64)
+        # class_weight must be resolved against ORIGINAL labels, before
+        # encoding remaps them to 0..k-1 (a dict keyed by user classes
+        # would otherwise silently miss every row)
+        if self.class_weight is not None and \
+                kwargs.get("sample_weight") is None:
+            kwargs["sample_weight"] = self._class_weights_to_sample_weight(y)
+        # vectorized encode: _classes is sorted (np.unique), so the map
+        # c -> index is exactly searchsorted — no per-row dict lookups
+        y_enc = np.searchsorted(self._classes, y).astype(np.float64)
+        # eval_set targets go through the SAME encoding (metrics compare
+        # against the encoded training space); the (X, y) identity pair is
+        # rewritten to (X, y_enc) so the base fit's train_set-reuse
+        # shortcut still fires
+        eval_set = kwargs.get("eval_set")
+        if eval_set is not None:
+            enc_set = []
+            for vx, vy in eval_set:
+                if vx is X and vy is y:
+                    enc_set.append((X, y_enc))
+                    continue
+                vy_arr = np.asarray(vy).ravel()
+                unknown = ~np.isin(vy_arr, self._classes)
+                if unknown.any():
+                    raise ValueError(
+                        "eval_set contains labels unseen in training: "
+                        f"{np.unique(vy_arr[unknown])[:5]}")
+                enc_set.append(
+                    (vx, np.searchsorted(self._classes,
+                                         vy_arr).astype(np.float64)))
+            kwargs["eval_set"] = enc_set
         if self._n_classes > 2:
             self._objective = self.objective or "multiclass"
             self._other_params["num_class"] = self._n_classes

@@ -10,7 +10,7 @@ semantics for raw-value prediction (tree.h:218-284) vectorized over rows.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional
 
 import numpy as np

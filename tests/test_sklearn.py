@@ -182,3 +182,34 @@ def test_sklearn_estimator_checks_fast_subset():
     ec.check_classifiers_regression_target("LGBMClassifier", clf)
     ec.check_supervised_y_no_nan("LGBMClassifier", clf)
     ec.check_supervised_y_2d("LGBMClassifier", clf)
+
+
+def test_classifier_eval_set_and_class_weight_use_original_labels():
+    """eval_set targets are encoded through the training label map (string
+    labels + early stopping work end-to-end), and class_weight dicts are
+    resolved against ORIGINAL labels, not their encoded 0..k-1 indices."""
+    rng = np.random.RandomState(11)
+    X = rng.rand(600, 5)
+    y = np.where(X[:, 0] + 0.3 * rng.randn(600) > 0.5, "pos", "neg")
+    Xtr, ytr, Xv, yv = X[:400], y[:400], X[400:], y[400:]
+
+    clf = LGBMClassifier(n_estimators=50, num_leaves=7, learning_rate=0.3)
+    clf.fit(Xtr, ytr, eval_set=[(Xv, yv)], eval_metric="binary_logloss",
+            early_stopping_rounds=3, verbose=False)
+    evals = next(iter(clf.evals_result_.values()))["binary_logloss"]
+    assert len(evals) > 0 and np.isfinite(evals).all()
+    assert min(evals) < 0.69        # better than chance => labels aligned
+    # unseen eval labels are rejected, not silently miscoded
+    with pytest.raises(ValueError, match="unseen"):
+        LGBMClassifier(n_estimators=2).fit(
+            Xtr, ytr, eval_set=[(Xv, np.full(len(Xv), "???"))])
+
+    # class_weight keyed by the string classes must change the model
+    plain = LGBMClassifier(n_estimators=10, num_leaves=7).fit(Xtr, ytr)
+    weighted = LGBMClassifier(n_estimators=10, num_leaves=7,
+                              class_weight={"pos": 25.0, "neg": 1.0}).fit(
+        Xtr, ytr)
+    p_plain = plain.predict_proba(Xv)[:, list(plain.classes_).index("pos")]
+    p_wt = weighted.predict_proba(Xv)[:, list(weighted.classes_).index("pos")]
+    # up-weighting "pos" must push predicted pos-probability up on average
+    assert p_wt.mean() > p_plain.mean() + 0.02
