@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..tree import Tree
+from ..utils.log import Log
 
 
 def _fmt_double(v: float) -> str:
@@ -99,6 +100,13 @@ def model_to_string(booster, num_iteration: Optional[int] = None) -> str:
     if booster.config.boosting_normalized == "rf":
         ss.append("average_output")
     names = booster.feature_names or [f"Column_{i}" for i in range(booster.num_total_features)]
+    if any(any(c.isspace() for c in n) for n in names):
+        # the text format is space-delimited (reference
+        # gbdt_model_text.cpp:190 joins with " " and never validates), so
+        # whitespace inside a name mis-splits on reload — warn loudly
+        Log.warning("feature names contain whitespace; the text model "
+                    "format is space-delimited and will mis-split them "
+                    "on load — rename features to round-trip names")
     ss.append("feature_names=" + " ".join(names))
     ss.append("feature_infos=" + " ".join(_feature_infos(booster)))
     ss.append("")

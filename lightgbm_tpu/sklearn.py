@@ -329,7 +329,6 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
                 "by classifiers; use LGBMRegressor for regression.")
         self._classes = np.unique(y)
         self._n_classes = len(self._classes)
-        self._label_map = {c: i for i, c in enumerate(self._classes)}
         # classes that still carry training signal after sample_weight
         # zeroing (sklearn contract: a problem reduced to one class must
         # predict that class; the reference core faithfully emits no trees
@@ -356,10 +355,14 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         self._fit_prevalidated = True
         # class_weight must be resolved against ORIGINAL labels, before
         # encoding remaps them to 0..k-1 (a dict keyed by user classes
-        # would otherwise silently miss every row)
-        if self.class_weight is not None and \
-                kwargs.get("sample_weight") is None:
-            kwargs["sample_weight"] = self._class_weights_to_sample_weight(y)
+        # would otherwise silently miss every row) — and it COMPOSES with a
+        # user sample_weight multiplicatively (reference sklearn wrapper's
+        # np.multiply of the two)
+        if self.class_weight is not None:
+            cw = self._class_weights_to_sample_weight(y)
+            sw = kwargs.get("sample_weight")
+            kwargs["sample_weight"] = cw if sw is None else \
+                np.asarray(sw, dtype=np.float64) * cw
         # vectorized encode: _classes is sorted (np.unique), so the map
         # c -> index is exactly searchsorted — no per-row dict lookups
         y_enc = np.searchsorted(self._classes, y).astype(np.float64)

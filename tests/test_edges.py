@@ -1,0 +1,71 @@
+"""Edge-case probes promoted to regression tests: degenerate inputs that a
+user of the reference would expect to just work (reference test_engine.py's
+missing-value and shape suites are the model)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def test_high_cardinality_categorical_bitset_roundtrip():
+    """>64 categories forces multi-word bitsets in the text format
+    (reference tree.cpp cat_threshold is a u32 array; any count works)."""
+    rng = np.random.RandomState(9)
+    X = rng.randint(0, 100, size=(2000, 3)).astype(np.float64)
+    y = (X[:, 0] % 7 < 3).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    b = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 15,
+                   "min_data_in_leaf": 5}, ds, num_boost_round=10)
+    p = b.predict(X)
+    assert np.mean((p > 0.5) == y) > 0.9
+    b2 = lgb.Booster(model_str=b.model_to_string())
+    np.testing.assert_allclose(b2.predict(X), p, rtol=1e-6)
+
+
+def test_all_nan_column_and_nan_rows_at_predict():
+    rng = np.random.RandomState(10)
+    X = rng.rand(1000, 4)
+    X[:, 2] = np.nan                      # never splittable
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    b = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 7},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    Xq = X.copy()
+    Xq[:5, 0] = np.nan                    # missing on the split feature
+    assert np.isfinite(b.predict(Xq)).all()
+
+
+def test_single_feature_dataset():
+    rng = np.random.RandomState(11)
+    X = rng.rand(500, 1)
+    y = (X[:, 0] > 0.6).astype(np.float32)
+    b = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    assert np.mean((b.predict(X) > 0.5) == y) > 0.95
+
+
+def test_constant_label_regression():
+    rng = np.random.RandomState(12)
+    X = rng.rand(200, 3)
+    y = np.full(200, 3.25, np.float32)
+    b = lgb.train({"objective": "regression", "verbose": -1, "num_leaves": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    np.testing.assert_allclose(b.predict(X), 3.25, atol=1e-5)
+
+
+def test_whitespace_feature_names_warn_on_save(caplog):
+    """The text format is space-delimited (reference gbdt_model_text.cpp:190
+    joins names with \" \" unvalidated); saving such names warns."""
+    rng = np.random.RandomState(13)
+    X = rng.rand(300, 3)
+    ds = lgb.Dataset(X, label=X[:, 0], feature_name=["a b", "x:y", "ok"])
+    b = lgb.train({"objective": "regression", "verbose": -1, "num_leaves": 5},
+                  ds, num_boost_round=2)
+    import io
+    import logging
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    logging.getLogger("lightgbm_tpu").addHandler(handler)
+    try:
+        b.model_to_string()
+    finally:
+        logging.getLogger("lightgbm_tpu").removeHandler(handler)
+    assert "whitespace" in stream.getvalue()

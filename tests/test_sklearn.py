@@ -213,3 +213,21 @@ def test_classifier_eval_set_and_class_weight_use_original_labels():
     p_wt = weighted.predict_proba(Xv)[:, list(weighted.classes_).index("pos")]
     # up-weighting "pos" must push predicted pos-probability up on average
     assert p_wt.mean() > p_plain.mean() + 0.02
+
+
+def test_class_weight_composes_with_sample_weight():
+    """class_weight multiplies into a user sample_weight (the reference
+    wrapper's np.multiply), rather than being silently dropped."""
+    rng = np.random.RandomState(21)
+    X = rng.rand(500, 4)
+    # class overlap (noise) so the optimum is weight-sensitive — on
+    # separable data re-weighting cannot move the decision boundary
+    y = np.where(X[:, 0] + 0.4 * rng.randn(500) > 0.55, "pos", "neg")
+    sw = rng.uniform(0.5, 1.5, 500)
+    kw = dict(n_estimators=10, num_leaves=7)
+    plain = LGBMClassifier(**kw).fit(X, y, sample_weight=sw)
+    boosted = LGBMClassifier(class_weight={"pos": 30.0, "neg": 1.0},
+                             **kw).fit(X, y, sample_weight=sw)
+    i_pos = list(plain.classes_).index("pos")
+    assert (boosted.predict_proba(X)[:, i_pos].mean()
+            > plain.predict_proba(X)[:, i_pos].mean() + 0.02)
