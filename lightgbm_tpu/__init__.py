@@ -19,7 +19,8 @@ from .config import Config
 from .basic import Booster, Dataset
 from .utils.log import LightGBMError
 from .engine import train, cv
-from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
+from .callback import (early_stopping, log_evaluation, print_evaluation,
+                       record_evaluation, reset_parameter)
 from .sklearn import LGBMModel, LGBMClassifier, LGBMRegressor, LGBMRanker
 from .plotting import plot_importance, plot_metric, plot_tree, create_tree_digraph
 
@@ -32,6 +33,7 @@ __all__ = [
     "cv",
     "early_stopping",
     "log_evaluation",
+    "print_evaluation",
     "record_evaluation",
     "reset_parameter",
     "LGBMModel",
