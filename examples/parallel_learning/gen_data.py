@@ -1,0 +1,11 @@
+"""Generate a small binary-classification dataset in the reference's TSV
+layout (label in column 0) for the parallel-learning example."""
+import numpy as np
+
+rng = np.random.RandomState(0)
+for name, n in (("binary.train", 7000), ("binary.test", 500)):
+    X = rng.rand(n, 28).astype(np.float32)
+    logit = X[:, 0] * 4 - X[:, 1] * 2 + X[:, 2] * X[:, 3] * 3 - 1.4
+    y = (logit + rng.randn(n) * 0.7 > 0).astype(int)
+    np.savetxt(name, np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+print("wrote binary.train / binary.test")

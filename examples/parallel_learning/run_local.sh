@@ -1,0 +1,15 @@
+#!/bin/bash
+# 2-process smoke run on one host (both ranks on 127.0.0.1; real clusters
+# just put real addresses in mlist.txt and run one process per machine).
+set -e
+cd "$(dirname "$0")"
+python gen_data.py
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+python -m lightgbm_tpu.cli train config=train.conf local_listen_port=12400 &
+P0=$!
+# a foreground failure must not orphan rank 0 holding its listen port
+trap 'kill $P0 2>/dev/null || true' EXIT
+python -m lightgbm_tpu.cli train config=train.conf local_listen_port=12401
+wait $P0
+trap - EXIT
+echo "model written: LightGBM_model.txt"

@@ -22,6 +22,12 @@ from .io.file_io import load_data_file
 from .utils.log import Log
 
 
+# every task value main() dispatches on (bare-subcommand whitelist derives
+# from this so the two can't drift)
+TASK_TOKENS = ("train", "predict", "prediction", "test",
+               "convert_model", "convert")
+
+
 def parse_args(argv: List[str]) -> Dict[str, str]:
     """argv `key=value` pairs + conf file merge; argv wins on conflict
     (reference Application::LoadParameters, application.cpp:48-81)."""
@@ -31,7 +37,17 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
         if not tok or tok.startswith("#"):
             continue
         if "=" not in tok:
-            Log.warning("Unknown argument %s (expected key=value)", tok)
+            # convenience subcommand form: `cli train config=...` ==
+            # `cli task=train config=...` (the reference CLI is strictly
+            # key=value, application.cpp:48-81; the bare form costs
+            # nothing). Must cover exactly main()'s dispatch set incl.
+            # aliases — see TASK_TOKENS.
+            if tok in TASK_TOKENS:
+                if cli.setdefault("task", tok) != tok:
+                    Log.warning("task already set to %s; ignoring bare "
+                                "subcommand %s", cli["task"], tok)
+            else:
+                Log.warning("Unknown argument %s (expected key=value)", tok)
             continue
         k, v = tok.split("=", 1)
         cli[k.strip()] = v.strip().strip('"')
@@ -134,7 +150,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     params = parse_args(argv)
     task = params.get("task", "train")
-    if task == "train" or task == "refit":
+    if task == "train":
         run_train(params)
     elif task in ("predict", "prediction", "test"):
         run_predict(params)

@@ -141,8 +141,15 @@ def save_model_file(booster, filename: str, num_iteration: Optional[int] = None)
         from .model_proto import save_model_proto
         save_model_proto(booster, filename, num_iteration)
         return
-    with open(filename, "w") as fh:
+    # atomic write: every rank of a distributed run saves (the reference's
+    # behavior — each machine keeps a local copy), and same-host ranks must
+    # not interleave into a truncated file; tmp-per-pid + rename means the
+    # last complete writer wins
+    import os
+    tmp = f"{filename}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
         fh.write(model_to_string(booster, num_iteration))
+    os.replace(tmp, filename)
 
 
 def _parse_tree_block(lines: Dict[str, str]) -> Tree:
