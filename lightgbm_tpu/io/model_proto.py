@@ -7,6 +7,7 @@ serialize/parse across implementations.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import numpy as np
@@ -80,8 +81,12 @@ def save_model_proto(booster, filename: str, num_iteration: Optional[int] = None
     m.feature_infos.extend(_feature_infos(booster))
     for t in trees:
         _tree_to_proto(t, m.trees.add())
-    with open(filename, "wb") as fh:
+    # atomic, like the text writer: concurrent same-host ranks must not
+    # interleave into a truncated file
+    tmp = f"{filename}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
         fh.write(m.SerializeToString())
+    os.replace(tmp, filename)
 
 
 def load_model_proto(booster, filename: str) -> None:
