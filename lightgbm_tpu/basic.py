@@ -291,6 +291,9 @@ class Booster:
         self.mappers = []
         self.init_score_value = 0.0
         self.pandas_categorical = None
+        self._attr: Dict[str, str] = {}
+        self._train_data_name = "training"
+        self._valid_registry: List = []      # (Dataset, name) identity pairs
         if model_file is not None:
             from .io.model_text import load_model_file
             load_model_file(self, model_file)
@@ -325,6 +328,23 @@ class Booster:
         if data.reference is None or data._binned_aligned is None:
             Log.fatal("Add valid data failed: valid set must reference the training set")
         self._gbdt.add_valid(name, data._binned_aligned, data._metadata)
+        self._valid_registry.append((data, name))
+        # replay the already-trained forest into the new valid score (the
+        # reference's AddValidDataset replays iter_ trees; without this,
+        # eval on late-attached data would score the INITIAL model). The
+        # fresh seed holds init_score_value which the finalized trees also
+        # carry (bias folded into tree 0) — subtract it before adding.
+        self._ensure_finalized()
+        if self.trees:
+            gbdt = self._gbdt
+            K = max(self.num_model_per_iteration, 1)
+            raw = np.asarray(self.predict(
+                data.raw_data, raw_score=True,
+                num_iteration=len(self.trees) // K), np.float32)
+            raw = raw.T if raw.ndim == 2 else raw.reshape(1, -1)
+            vs = gbdt.valid_sets[-1]
+            vs.score = (vs.score - np.float32(gbdt.init_score_value)
+                        + gbdt._put(raw.reshape(K, vs.num_data)))
         return self
 
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
@@ -396,6 +416,18 @@ class Booster:
         if hasattr(self, "train_dataset"):
             del self.train_dataset
         return self
+
+    def _ensure_finalized(self):
+        """Materialize host trees iff device state has newer iterations
+        (shared by get_leaf_output, the C API's lazy sync, and eval-time
+        replay; one home for the K/prev-trees accounting)."""
+        if self._gbdt is None:
+            return
+        K = max(self.num_model_per_iteration, 1)
+        expected = (len(getattr(self, "_prev_trees", []))
+                    + self._gbdt.iter_ * K)
+        if len(self.trees) != expected:
+            self._finalize()
 
     def _finalize(self):
         forest = self._gbdt.finalize_model()
@@ -554,8 +586,106 @@ class Booster:
 
     # -- evaluation ----------------------------------------------------------
 
-    def eval_valid(self):
-        return self._gbdt.eval_all()
+    def _feval_results(self, feval, dataset_name):
+        """Run a custom eval callable for one attached dataset (reference
+        __inner_eval's feval leg, basic.py:1612-1620)."""
+        if feval is None:
+            return []
+        out = []
+        if dataset_name == self._train_data_name:
+            preds = self._gbdt._fetch(self._gbdt._convert(self._gbdt.score))[
+                :, self._gbdt._real_rows()].reshape(-1)
+            res = feval(preds, self.train_dataset)
+            res = [res] if isinstance(res, tuple) else res
+            out.extend((dataset_name, n, v, h) for n, v, h in res)
+            return out
+        for vs in self._gbdt.valid_sets:
+            if vs.name == dataset_name:
+                preds = self._gbdt._fetch(
+                    self._gbdt._convert(vs.score)).reshape(-1)
+                res = feval(preds, vs)
+                res = [res] if isinstance(res, tuple) else res
+                out.extend((dataset_name, n, v, h) for n, v, h in res)
+        return out
+
+    def eval(self, data, name, feval=None):
+        """Evaluate the current model on `data` (reference basic.py:1543):
+        the training set, an attached valid set, or a new Dataset (which is
+        attached as a valid set first, like the reference's push)."""
+        if not isinstance(data, Dataset):
+            raise TypeError("Can only eval for Dataset instance")
+        if data is getattr(self, "train_dataset", None):
+            return self.eval_train(feval)
+        for ds, nm in self._valid_registry:
+            if data is ds:
+                return (self._gbdt.eval_all(only=nm)
+                        + self._feval_results(feval, nm))
+        self.add_valid(data, name)
+        return (self._gbdt.eval_all(only=name)
+                + self._feval_results(feval, name))
+
+    def eval_train(self, feval=None):
+        """Evaluate on the training data (reference basic.py:1577)."""
+        res = [(self._train_data_name, n, v, h)
+               for d, n, v, h in self._gbdt.eval_all(force_training=True,
+                                                     only="training")]
+        return res + self._feval_results(feval, self._train_data_name)
+
+    def eval_valid(self, feval=None):
+        """Evaluate on every attached validation set (basic.py:1592)."""
+        names = [nm for _ds, nm in self._valid_registry] or             [vs.name for vs in self._gbdt.valid_sets]
+        res = [r for r in self._gbdt.eval_all() if r[0] != "training"]
+        if feval is not None:
+            for nm in names:
+                res.extend(self._feval_results(feval, nm))
+        return res
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """Display name of the training data in eval output
+        (reference basic.py:1400)."""
+        self._train_data_name = name
+        return self
+
+    # -- attributes (reference basic.py:1932-1969: in-memory k/v store) ------
+
+    def attr(self, key: str):
+        return self._attr.get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        for k, v in kwargs.items():
+            if v is None:
+                self._attr.pop(k, None)
+            else:
+                self._attr[k] = str(v)
+        return self
+
+    # -- network (reference basic.py:1374-1399) ------------------------------
+
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120,
+                    num_machines: int = 1) -> "Booster":
+        """Record the distributed wiring params (reference SetNetwork).
+        Here the mesh is wired when training starts (jax.distributed),
+        so calling this after a booster has trained only affects the
+        next training setup."""
+        if not isinstance(machines, str):
+            machines = ",".join(machines)
+        self.params.update(machines=machines,
+                           local_listen_port=local_listen_port,
+                           time_out=listen_time_out,
+                           num_machines=num_machines)
+        self.config = Config.from_params(self.params)
+        if self._gbdt is not None:
+            Log.warning("set_network after training setup applies to the "
+                        "next training, not the current booster")
+        return self
+
+    def free_network(self) -> "Booster":
+        for k in ("machines", "local_listen_port", "time_out",
+                  "num_machines"):
+            self.params.pop(k, None)
+        self.config = Config.from_params(self.params)
+        return self
 
     # -- model io ------------------------------------------------------------
 
@@ -591,10 +721,24 @@ class Booster:
     def feature_name(self) -> List[str]:
         return list(self.feature_names)
 
+    def num_feature(self) -> int:
+        """Number of (raw) features the model was trained on
+        (reference basic.py:1775 / LGBM_BoosterGetNumFeature)."""
+        return int(self.num_total_features)
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Output value of one leaf (reference basic.py:1746 /
+        LGBM_BoosterGetLeafValue)."""
+        self._ensure_finalized()
+        return float(self.trees[tree_id].leaf_value[leaf_id])
+
     def __getstate__(self):
         state = self.__dict__.copy()
         state.pop("_gbdt", None)
         state.pop("train_dataset", None)
+        # registry holds live Datasets (whose .reference is the training
+        # set) — stale after unpickling anyway since _gbdt is dropped
+        state["_valid_registry"] = []
         return state
 
     def __setstate__(self, state):

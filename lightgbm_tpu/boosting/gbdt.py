@@ -778,11 +778,15 @@ class GBDT:
             return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
         return np.asarray(arr)
 
-    def eval_all(self) -> List[Tuple[str, str, float, bool]]:
+    def eval_all(self, force_training=False, only=None
+                 ) -> List[Tuple[str, str, float, bool]]:
+        """only=<dataset name>: evaluate just that dataset (single-dataset
+        entry points must not pay for every attached valid set)."""
         with TIMERS("metric_eval"):
-            return self._eval_all()
+            return self._eval_all(force_training, only)
 
-    def _eval_all(self) -> List[Tuple[str, str, float, bool]]:
+    def _eval_all(self, force_training=False, only=None
+                  ) -> List[Tuple[str, str, float, bool]]:
         """Metric evaluation with a DEVICE scalar path for the pointwise
         family: the weighted-average loss reduces on device and only one
         scalar per metric crosses to the host (VERDICT r2 weak #9 — the
@@ -818,12 +822,15 @@ class GBDT:
                     for name, value, hib in m.eval(conv_host):
                         out.append([dname, name, value, hib, None])
 
-        if self.config.is_training_metric and self.train_metrics:
+        if (self.config.is_training_metric or force_training) \
+                and self.train_metrics and only in (None, "training"):
             eval_dataset(
                 "training", self.train_metrics, self.score, self.label,
                 self.weight, self.pad_mask,
                 lambda: self._fetch(self._convert(self.score))[:, self._real_rows()])
         for vs in self.valid_sets:
+            if only is not None and vs.name != only:
+                continue
             if not hasattr(vs, "label_dev"):
                 vs.label_dev = self._put(
                     np.asarray(vs.metadata.label, np.float32))

@@ -563,12 +563,7 @@ def _sync(bst: Booster) -> Booster:
     """Materialize host trees from device state — the C API drives raw
     update() calls, so predict/save/dump must see the current forest
     (engine.train does this once at the end; here it's lazy per call)."""
-    gbdt = bst._gbdt
-    if gbdt is not None:
-        K = max(bst.num_model_per_iteration, 1)
-        expected = len(getattr(bst, "_prev_trees", [])) + gbdt.iter_ * K
-        if len(bst.trees) != expected:
-            bst._finalize()
+    bst._ensure_finalized()
     return bst
 
 

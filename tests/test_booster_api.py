@@ -1,0 +1,95 @@
+"""Booster API surface parity with the reference python package
+(basic.py Booster methods: eval/eval_train/eval_valid, attr/set_attr,
+num_feature, get_leaf_output, set_train_data_name, set/free_network)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(8)
+    X = rng.rand(800, 5)
+    y = (X[:, 0] + 0.2 * rng.randn(800) > 0.5).astype(np.float32)
+    ds = lgb.Dataset(X[:600], label=y[:600])
+    vs = lgb.Dataset(X[600:], label=y[600:], reference=ds)
+    bst = lgb.Booster(params={"objective": "binary", "verbose": -1,
+                              "num_leaves": 15, "metric": "auc"},
+                      train_set=ds)
+    bst.add_valid(vs, "va")
+    for _ in range(8):
+        bst.update()
+    return bst, ds, vs, X, y
+
+
+def test_eval_train_valid_and_eval(trained):
+    bst, ds, vs, X, y = trained
+    tr = bst.eval_train()
+    assert tr and tr[0][0] == "training" and tr[0][1] == "auc"
+    assert 0.5 < tr[0][2] <= 1.0
+    va = bst.eval_valid()
+    assert va and va[0][0] == "va"
+    # eval() dispatches on identity: train set, attached valid, new data
+    assert bst.eval(ds, "ignored")[0][0] == "training"
+    assert bst.eval(vs, "ignored")[0][0] == "va"
+    rng = np.random.RandomState(9)
+    Xn = rng.rand(400, 5)
+    yn = (Xn[:, 0] + 0.2 * rng.randn(400) > 0.5).astype(np.float32)
+    fresh = lgb.Dataset(Xn, label=yn, reference=ds)
+    out = bst.eval(fresh, "extra")
+    assert out and out[0][0] == "extra"
+    # the late-attached set must be scored by the TRAINED model (the
+    # forest is replayed into its score), matching host predictions
+    from bench import _auc
+    want = _auc(yn, bst.predict(Xn))
+    got = [v for d, n, v, h in out if n == "auc"][0]
+    # f32 device replay vs f64 host predict: near-tie rank swaps only
+    assert abs(got - want) < 5e-3, (got, want)
+    assert got > 0.8
+
+    # custom feval flows through each eval entry point
+    def zero_metric(preds, dataset):
+        return "zero", float(np.mean(preds) * 0), True
+
+    assert ("training", "zero", 0.0, True) in bst.eval_train(zero_metric)
+    assert any(r[1] == "zero" for r in bst.eval_valid(zero_metric))
+
+
+def test_set_train_data_name(trained):
+    bst = trained[0]
+    bst.set_train_data_name("mytrain")
+    assert bst.eval_train()[0][0] == "mytrain"
+    bst.set_train_data_name("training")
+
+
+def test_attr_roundtrip(trained):
+    bst = trained[0]
+    assert bst.attr("missing") is None
+    bst.set_attr(owner="me", version="3")
+    assert bst.attr("owner") == "me" and bst.attr("version") == "3"
+    bst.set_attr(owner=None)
+    assert bst.attr("owner") is None
+
+
+def test_num_feature_and_leaf_output(trained):
+    bst = trained[0]
+    assert bst.num_feature() == 5
+    v = bst.get_leaf_output(0, 0)
+    assert np.isfinite(v)
+    # matches the model dump
+    t0 = bst.dump_model()["tree_info"][0]["tree_structure"] \
+        if not isinstance(bst.dump_model(), str) else None
+    s = bst.model_to_string()
+    first = float([l for l in s.splitlines()
+                   if l.startswith("leaf_value=")][0].split("=")[1].split()[0])
+    assert abs(v - first) < 1e-9
+
+
+def test_set_free_network(trained):
+    bst = trained[0]
+    bst.set_network(["10.0.0.1:12400", "10.0.0.2:12400"],
+                    local_listen_port=12400, num_machines=2)
+    assert bst.params["num_machines"] == 2
+    bst.free_network()
+    assert "machines" not in bst.params
