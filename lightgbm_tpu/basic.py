@@ -216,9 +216,68 @@ class Dataset:
             self._constructed.metadata.set_init_score(init_score)
         return self
 
+    def get_group(self):
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
     def get_field(self, name):
         return {"label": self.label, "weight": self.weight,
                 "group": self.group, "init_score": self.init_score}[name]
+
+    def set_field(self, name, data):
+        """Generic field setter (reference basic.py Dataset.set_field /
+        LGBM_DatasetSetField): routes to the typed setters."""
+        setter = {"label": self.set_label, "weight": self.set_weight,
+                  "group": self.set_group,
+                  "init_score": self.set_init_score}.get(name)
+        if setter is None:
+            raise ValueError(f"Unknown field name: {name}")
+        return setter(data)
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """Bin this dataset with `reference`'s mappers (reference
+        basic.py set_reference). Must precede construction."""
+        if self._constructed is not None or self._binned_aligned is not None:
+            if self.reference is reference:
+                return self
+            raise ValueError(
+                "Cannot set reference after the dataset was constructed")
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """Set of datasets reachable through .reference links
+        (reference basic.py:878)."""
+        head, chain = self, set()
+        while head is not None and len(chain) < ref_limit:
+            if head in chain:
+                break
+            chain.add(head)
+            head = head.reference
+        return chain
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        if feature_name is not None and feature_name != "auto":
+            self.feature_name = list(feature_name)
+            if self._constructed is not None:
+                self._constructed.feature_names = list(feature_name)
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """Must precede construction (binning depends on it), like the
+        reference's re-construct warning path."""
+        old = self.categorical_feature
+        same = (categorical_feature is old
+                or (old is not None and categorical_feature is not None
+                    and list(categorical_feature) == list(old)))
+        if (self._constructed is not None
+                or self._binned_aligned is not None) and not same:
+            raise ValueError("Cannot change categorical_feature after the "
+                             "dataset was constructed")
+        self.categorical_feature = categorical_feature
+        return self
 
     def save_binary(self, filename: str) -> "Dataset":
         self.constructed.save_binary(filename)
@@ -327,6 +386,9 @@ class Booster:
         data.construct(self.config)
         if data.reference is None or data._binned_aligned is None:
             Log.fatal("Add valid data failed: valid set must reference the training set")
+        if any(nm == name for _ds, nm in self._valid_registry):
+            Log.fatal("A validation set named %r is already attached; "
+                      "names must be unique per booster", name)
         self._gbdt.add_valid(name, data._binned_aligned, data._metadata)
         self._valid_registry.append((data, name))
         # replay the already-trained forest into the new valid score (the
@@ -336,6 +398,10 @@ class Booster:
         # carry (bias folded into tree 0) — subtract it before adding.
         self._ensure_finalized()
         if self.trees:
+            if data.raw_data is None:
+                Log.fatal("add_valid after training needs the valid set's "
+                          "raw data to replay the forest — construct it "
+                          "with free_raw_data=False")
             gbdt = self._gbdt
             K = max(self.num_model_per_iteration, 1)
             raw = np.asarray(self.predict(
@@ -593,9 +659,13 @@ class Booster:
             return []
         out = []
         if dataset_name == self._train_data_name:
+            train_ds = getattr(self, "train_dataset", None)
+            if train_ds is None:
+                Log.fatal("eval_train with a custom feval needs the "
+                          "training Dataset, which free_dataset() released")
             preds = self._gbdt._fetch(self._gbdt._convert(self._gbdt.score))[
                 :, self._gbdt._real_rows()].reshape(-1)
-            res = feval(preds, self.train_dataset)
+            res = feval(preds, train_ds)
             res = [res] if isinstance(res, tuple) else res
             out.extend((dataset_name, n, v, h) for n, v, h in res)
             return out

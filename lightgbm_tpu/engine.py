@@ -23,6 +23,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
           early_stopping_rounds: Optional[int] = None,
           evals_result: Optional[Dict] = None,
           verbose_eval: Union[bool, int] = True,
+          learning_rates=None,
           keep_training_booster: bool = False,
           callbacks: Optional[List[Callable]] = None) -> Booster:
     """Mirror of reference engine.py:18 lgb.train."""
@@ -92,6 +93,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster._prev_trees = list(prev_booster.trees[: n_prev_iters * Kp])
 
     callbacks = list(callbacks or [])
+    if learning_rates is not None:
+        # reference engine.py: list-or-callable schedule routed through
+        # the reset_parameter callback
+        from .callback import reset_parameter
+        callbacks.append(reset_parameter(learning_rate=learning_rates))
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
         if not booster._gbdt.valid_sets:
             Log.fatal("For early stopping, at least one validation dataset is required")

@@ -93,3 +93,70 @@ def test_set_free_network(trained):
     assert bst.params["num_machines"] == 2
     bst.free_network()
     assert "machines" not in bst.params
+
+
+def test_dataset_field_api_surface():
+    """Dataset getter/setter parity with the reference (set_field,
+    get_group/init_score, set_reference, get_ref_chain,
+    set_categorical_feature guards)."""
+    rng = np.random.RandomState(14)
+    X = rng.rand(300, 4)
+    y = X[:, 0]
+    ds = lgb.Dataset(X, label=y)
+    ds.set_field("weight", np.ones(300))
+    assert ds.get_field("weight") is not None
+    ds.set_field("init_score", np.zeros(300))
+    assert len(ds.get_init_score()) == 300
+    with pytest.raises(ValueError, match="Unknown field"):
+        ds.set_field("nope", y)
+
+    va = lgb.Dataset(X[:100], label=y[:100])
+    va.set_reference(ds)
+    chain = va.get_ref_chain()
+    assert ds in chain and va in chain
+
+    ds.set_categorical_feature([1])
+    ds.construct()
+    with pytest.raises(ValueError, match="categorical_feature"):
+        ds.set_categorical_feature([2])
+    with pytest.raises(ValueError, match="reference"):
+        va.construct() and va.set_reference(lgb.Dataset(X, label=y))
+    # same reference re-set after construction is a no-op
+    va.set_reference(ds)
+
+    rk = lgb.Dataset(X, label=(y > 0.5).astype(int),
+                     group=np.array([150, 150]))
+    assert list(rk.get_group()) == [150, 150]
+
+
+def test_train_learning_rates_schedule():
+    """train(learning_rates=callable) routes through reset_parameter
+    (reference engine.py) and actually shrinks late-tree contributions."""
+    rng = np.random.RandomState(15)
+    X = rng.rand(500, 4)
+    y = X[:, 0] * 2 + 0.1 * rng.randn(500)
+    base = {"objective": "regression", "verbose": -1, "num_leaves": 7,
+            "learning_rate": 0.5}
+    b1 = lgb.train(dict(base), lgb.Dataset(X, label=y), num_boost_round=6)
+    b2 = lgb.train(dict(base), lgb.Dataset(X, label=y), num_boost_round=6,
+                   learning_rates=lambda it: 0.5 * (0.1 ** it))
+    # decayed schedule: later trees contribute far less than constant-lr
+    l1 = [abs(b1.get_leaf_output(5, i)) for i in range(3)]
+    l2 = [abs(b2.get_leaf_output(5, i)) for i in range(3)]
+    assert max(l2) < max(l1)
+
+
+def test_add_valid_guards(trained):
+    """Duplicate valid names are rejected; replaying the forest into a
+    late-attached set requires its raw data."""
+    bst, ds, vs, X, y = trained
+    from lightgbm_tpu import LightGBMError
+    dup = lgb.Dataset(X[:50], label=y[:50], reference=ds)
+    with pytest.raises(LightGBMError, match="unique"):
+        bst.add_valid(dup, "va")
+    freed = lgb.Dataset(X[:50], label=y[:50], reference=ds,
+                        free_raw_data=True)
+    freed.construct()
+    assert freed.raw_data is None
+    with pytest.raises(LightGBMError, match="free_raw_data"):
+        bst.add_valid(freed, "freed")
