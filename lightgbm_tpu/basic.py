@@ -189,10 +189,19 @@ class Dataset:
     def get_label(self):
         return self.label
 
+    def _meta_sink(self):
+        """The metadata object live state writes through to: a constructed
+        training set's, or a reference-aligned valid set's (basic
+        construct() stores the latter in _metadata)."""
+        if self._constructed is not None:
+            return self._constructed.metadata
+        return getattr(self, "_metadata", None)
+
     def set_label(self, label):
         self.label = None if label is None else np.asarray(label).reshape(-1)
-        if self._constructed is not None and self.label is not None:
-            self._constructed.metadata.set_label(self.label)
+        sink = self._meta_sink()
+        if sink is not None and self.label is not None:
+            sink.set_label(self.label)
         return self
 
     def get_weight(self):
@@ -200,20 +209,23 @@ class Dataset:
 
     def set_weight(self, weight):
         self.weight = weight
-        if self._constructed is not None:
-            self._constructed.metadata.set_weight(weight)
+        sink = self._meta_sink()
+        if sink is not None:
+            sink.set_weight(weight)
         return self
 
     def set_group(self, group):
         self.group = group
-        if self._constructed is not None:
-            self._constructed.metadata.set_group(group)
+        sink = self._meta_sink()
+        if sink is not None:
+            sink.set_group(group)
         return self
 
     def set_init_score(self, init_score):
         self.init_score = init_score
-        if self._constructed is not None:
-            self._constructed.metadata.set_init_score(init_score)
+        sink = self._meta_sink()
+        if sink is not None:
+            sink.set_init_score(init_score)
         return self
 
     def get_group(self):
@@ -244,6 +256,16 @@ class Dataset:
                 return self
             raise ValueError(
                 "Cannot set reference after the dataset was constructed")
+        if self.pandas_categorical is not None and \
+                self.pandas_categorical != getattr(
+                    reference, "pandas_categorical", None):
+            # category CODES were fixed at __init__ against this frame's
+            # (or the old reference's) category lists; re-referencing would
+            # bin those codes with mappers from a different list order
+            raise ValueError(
+                "Cannot set_reference on a pandas-categorical dataset "
+                "encoded against different category lists — rebuild the "
+                "Dataset with reference= instead")
         self.reference = reference
         return self
 
@@ -260,7 +282,15 @@ class Dataset:
 
     def set_feature_name(self, feature_name) -> "Dataset":
         if feature_name is not None and feature_name != "auto":
-            self.feature_name = list(feature_name)
+            feature_name = list(feature_name)
+            nf = self.raw_data.shape[1] if self.raw_data is not None else \
+                (self._constructed.num_total_features
+                 if self._constructed is not None else None)
+            if nf is not None and len(feature_name) != nf:
+                raise ValueError(
+                    f"Length of feature_name ({len(feature_name)}) does "
+                    f"not equal the number of features ({nf})")
+            self.feature_name = feature_name
             if self._constructed is not None:
                 self._constructed.feature_names = list(feature_name)
         return self
@@ -268,6 +298,8 @@ class Dataset:
     def set_categorical_feature(self, categorical_feature) -> "Dataset":
         """Must precede construction (binning depends on it), like the
         reference's re-construct warning path."""
+        if categorical_feature == "auto":
+            categorical_feature = None          # __init__'s normalization
         old = self.categorical_feature
         same = (categorical_feature is old
                 or (old is not None and categorical_feature is not None
@@ -386,9 +418,16 @@ class Booster:
         data.construct(self.config)
         if data.reference is None or data._binned_aligned is None:
             Log.fatal("Add valid data failed: valid set must reference the training set")
+        # every failure mode is checked BEFORE any booster mutation — a
+        # caught error must not leave a half-attached valid set behind
         if any(nm == name for _ds, nm in self._valid_registry):
             Log.fatal("A validation set named %r is already attached; "
                       "names must be unique per booster", name)
+        self._ensure_finalized()
+        if self.trees and data.raw_data is None:
+            Log.fatal("add_valid after training needs the valid set's "
+                      "raw data to replay the forest — construct it "
+                      "with free_raw_data=False")
         self._gbdt.add_valid(name, data._binned_aligned, data._metadata)
         self._valid_registry.append((data, name))
         # replay the already-trained forest into the new valid score (the
@@ -396,12 +435,7 @@ class Booster:
         # eval on late-attached data would score the INITIAL model). The
         # fresh seed holds init_score_value which the finalized trees also
         # carry (bias folded into tree 0) — subtract it before adding.
-        self._ensure_finalized()
         if self.trees:
-            if data.raw_data is None:
-                Log.fatal("add_valid after training needs the valid set's "
-                          "raw data to replay the forest — construct it "
-                          "with free_raw_data=False")
             gbdt = self._gbdt
             K = max(self.num_model_per_iteration, 1)
             raw = np.asarray(self.predict(

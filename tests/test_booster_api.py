@@ -160,3 +160,9 @@ def test_add_valid_guards(trained):
     assert freed.raw_data is None
     with pytest.raises(LightGBMError, match="free_raw_data"):
         bst.add_valid(freed, "freed")
+    # the failed attach must leave NO half-attached state: the name is
+    # still free and no 'freed' rows appear in eval_valid
+    assert all(r[0] != "freed" for r in bst.eval_valid())
+    ok = lgb.Dataset(X[:50], label=y[:50], reference=ds)
+    bst.add_valid(ok, "freed")
+    assert any(r[0] == "freed" for r in bst.eval_valid())
