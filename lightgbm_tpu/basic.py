@@ -256,9 +256,9 @@ class Dataset:
                 return self
             raise ValueError(
                 "Cannot set reference after the dataset was constructed")
-        if self.pandas_categorical is not None and \
-                self.pandas_categorical != getattr(
-                    reference, "pandas_categorical", None):
+        ref_pc = getattr(reference, "pandas_categorical", None) or None
+        if (self.pandas_categorical or None) is not None and \
+                self.pandas_categorical != ref_pc:
             # category CODES were fixed at __init__ against this frame's
             # (or the old reference's) category lists; re-referencing would
             # bin those codes with mappers from a different list order
@@ -283,9 +283,12 @@ class Dataset:
     def set_feature_name(self, feature_name) -> "Dataset":
         if feature_name is not None and feature_name != "auto":
             feature_name = list(feature_name)
-            nf = self.raw_data.shape[1] if self.raw_data is not None else \
-                (self._constructed.num_total_features
-                 if self._constructed is not None else None)
+            if self._constructed is not None:
+                nf = self._constructed.num_total_features
+            elif self.raw_data is not None and self.raw_data.shape[0] > 0:
+                nf = self.raw_data.shape[1]
+            else:           # binary/streaming placeholder raw_data
+                nf = None
             if nf is not None and len(feature_name) != nf:
                 raise ValueError(
                     f"Length of feature_name ({len(feature_name)}) does "
@@ -298,8 +301,9 @@ class Dataset:
     def set_categorical_feature(self, categorical_feature) -> "Dataset":
         """Must precede construction (binning depends on it), like the
         reference's re-construct warning path."""
-        if categorical_feature == "auto":
-            categorical_feature = None          # __init__'s normalization
+        if isinstance(categorical_feature, str) and \
+                categorical_feature == "auto":
+            return self     # auto = keep the auto-derived setting
         old = self.categorical_feature
         same = (categorical_feature is old
                 or (old is not None and categorical_feature is not None

@@ -231,6 +231,7 @@ class LGBMModel(_SKBase):
             X, y, n_feat = self._validate_fit_inputs(X, y)
             self.n_features_in_ = n_feat
         params = self._lgb_params()
+        params.update(self.__dict__.pop("_fit_params_extra", {}))
         # callable objective: the reference sklearn wrapper accepts
         # objective(y_true, y_pred) -> (grad, hess) and routes it as a
         # custom fobj (sklearn.py:137-213 _ObjectiveFunctionWrapper)
@@ -448,7 +449,14 @@ class LGBMRanker(LGBMModel):
         super().__init__(**kwargs)
         self._objective = kwargs.get("objective", "lambdarank")
 
-    def fit(self, X, y, group=None, **kwargs):
+    def fit(self, X, y, group=None, eval_at=None, **kwargs):
         if group is None:
             Log.fatal("Should set group for ranking task")
+        # NDCG truncation positions (reference sklearn.py LGBMRanker.fit's
+        # eval_at -> params['ndcg_eval_at']): fit-scoped — must not leak
+        # into get_params()/clone or override constructor params when
+        # omitted
+        if eval_at is not None:
+            self._fit_params_extra = {"ndcg_eval_at": list(
+                eval_at if hasattr(eval_at, "__iter__") else [eval_at])}
         return super().fit(X, y, group=group, **kwargs)

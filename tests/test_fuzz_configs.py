@@ -59,3 +59,40 @@ def test_random_config_sweep():
             raw = bst.predict(X, raw_score=True)
             np.testing.assert_allclose(c.sum(axis=1), raw,
                                        rtol=1e-4, atol=1e-4)
+
+
+def test_lifecycle_sweep():
+    """Boosting lifecycle invariants (CI slice of the round-5 3x25-trial
+    sweep): continuation tree counts, truncated predict == stage-1 model,
+    rollback_one_iter restores predictions, reset_parameter mid-train."""
+    rng = np.random.RandomState(5)
+    for trial in range(4):
+        n = 300
+        X = rng.rand(n, 5)
+        obj = ["regression", "binary"][trial % 2]
+        y = (X[:, 0] > 0.5).astype(np.float64) if obj == "binary" else \
+            X[:, 0] * 2 + 0.1 * rng.randn(n)
+        boosting = ["gbdt", "dart", "goss", "gbdt"][trial]
+        params = {"objective": obj, "boosting": boosting, "verbose": -1,
+                  "num_leaves": 7, "min_data_in_leaf": 5, "metric": "none"}
+        r1 = 3 + trial
+        b1 = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=r1)
+        b2 = lgb.train(dict(params, boosting="gbdt"),
+                       lgb.Dataset(X, label=y), num_boost_round=2,
+                       init_model=lgb.Booster(model_str=b1.model_to_string()))
+        assert b2.num_trees() == r1 + 2
+        np.testing.assert_allclose(b2.predict(X, num_iteration=r1),
+                                   b1.predict(X), rtol=1e-5, atol=1e-6)
+        b3 = lgb.Booster(params=dict(params, boosting="gbdt"),
+                         train_set=lgb.Dataset(X, label=y))
+        for _ in range(3):
+            b3.update()
+        before = b3.predict(X)
+        b3.update()
+        b3.rollback_one_iter()
+        np.testing.assert_allclose(b3.predict(X), before,
+                                   rtol=1e-4, atol=1e-5)
+        b3.reset_parameter({"learning_rate": 0.01})
+        b3.update()
+        assert np.isfinite(b3.predict(X)).all()

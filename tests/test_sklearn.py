@@ -231,3 +231,18 @@ def test_class_weight_composes_with_sample_weight():
     i_pos = list(plain.classes_).index("pos")
     assert (boosted.predict_proba(X)[:, i_pos].mean()
             > plain.predict_proba(X)[:, i_pos].mean() + 0.02)
+
+
+def test_ranker_eval_at():
+    """LGBMRanker.fit(eval_at=...) maps to ndcg_eval_at (reference
+    sklearn wrapper contract)."""
+    rng = np.random.RandomState(2)
+    X = rng.rand(600, 4)
+    y = rng.randint(0, 3, 600).astype(float)
+    g = np.full(20, 30)
+    rk = LGBMRanker(n_estimators=4, num_leaves=7, min_child_samples=5)
+    rk.fit(X[:450], y[:450], group=g[:15], eval_at=[3, 5],
+           eval_set=[(X[450:], y[450:])], eval_group=[g[15:]],
+           eval_metric="ndcg", verbose=False)
+    keys = set(next(iter(rk.evals_result_.values())))
+    assert keys == {"ndcg@3", "ndcg@5"}
