@@ -168,25 +168,30 @@ def main():
 
     # ---- 2. pallas on-chip gate ---------------------------------------
     _enter("gate")
-    gate_failures = None
+    headline_pallas = False
+    gate_validated = []
     try:
         spec = importlib.util.spec_from_file_location(
             "pallas_onchip_check",
             os.path.join(REPO, "exp", "pallas_onchip_check.py"))
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        gate_failures = mod.run_gate()
-        _bank("gate", {"failures": gate_failures,
-                       "phase_s": _phase_time()})
+        gate_result = mod.run_gate()
+        gate_validated = gate_result["validated"]
+        _bank("gate", dict(gate_result, phase_s=_phase_time()))
     except Exception as e:                                   # noqa: BLE001
         traceback.print_exc()
         _bank("gate", {"error": f"{type(e).__name__}: {e}"[:300]})
 
-    # ---- 3. quick again on pallas (auto now resolves there) -----------
-    if gate_failures == 0:
+    # ---- 3. quick again: bank whatever auto NOW resolves to (the banked
+    #      record's "kernel" field is the ground truth — no second copy of
+    #      gbdt's shape-key derivation here) ----------------------------
+    if gate_validated:
         _enter("quick_pallas")
         try:
-            _bank("quick_pallas", _quick_bench("quick_pallas"))
+            res = _quick_bench("quick_pallas")
+            headline_pallas = res.get("kernel") in ("pallas", "mixed")
+            _bank("quick_pallas", res)
         except Exception as e:                               # noqa: BLE001
             traceback.print_exc()
             _bank("quick_pallas", {"error": f"{type(e).__name__}: {e}"[:300]})
@@ -232,7 +237,7 @@ def main():
         _bank("sparse", {"error": f"{type(e).__name__}: {e}"[:300]})
 
     # ---- 7. full-scale XLA comparison (only if auto went pallas) ------
-    if gate_failures == 0:
+    if headline_pallas:
         _enter("full_xla")
         try:
             os.environ["LGBM_TPU_BENCH_KERNEL"] = "xla"

@@ -10,8 +10,11 @@ lightgbm_tpu.utils.cache.pallas_validated_on_chip(), which is what flips
 ``auto`` from the XLA fallback to the Pallas kernel for every subsequent
 process on this machine (including the driver's end-of-round bench run).
 
-Run: python -u exp/pallas_onchip_check.py
-Importable: run_gate() -> int (failure count; 0 writes the marker).
+Run: python -u exp/pallas_onchip_check.py  (exit 0 iff the marker was
+written, i.e. at least one shape class validated)
+Importable: run_gate() -> {"failures": int, "validated": [config keys]}.
+The marker is written whenever ``validated`` is non-empty — trust is
+per shape class (utils/cache.pallas_config_key), not all-or-nothing.
 """
 import datetime
 import json
@@ -27,8 +30,8 @@ def run_gate(write_marker=True):
     import jax.numpy as jnp
 
     from lightgbm_tpu.utils.cache import (
-        _libtpu_version, enable_compile_cache, pallas_gate_marker_path,
-        pallas_kernel_source_hash, repo_cache_dir)
+        _libtpu_version, enable_compile_cache, pallas_config_key,
+        pallas_gate_marker_path, pallas_kernel_source_hash, repo_cache_dir)
     enable_compile_cache(repo_cache_dir())
 
     from lightgbm_tpu.ops.histogram import build_histograms, pack_rows
@@ -45,14 +48,25 @@ def run_gate(write_marker=True):
     rng = np.random.RandomState(0)
     failures = 0
     worst_rel = 0.0
+    validated = []
     # LGBM_TPU_CHECK_SCALE=small shrinks rows for an interpret-mode smoke
     scale = 4096 if os.environ.get("LGBM_TPU_CHECK_SCALE") == "small" \
         else 1 << 17
-    for name, N, F, B, S, dtype, maxc in [
-            ("u8 B=256", scale, 28, 256, 16, np.uint8, 256),
-            ("u8 B=64", scale, 28, 64, 25, np.uint8, 64),
-            ("u16 B=512", scale // 2, 12, 512, 8, np.uint16, 512),
+    # The sweep covers the exact shape classes the benchmark dispatches
+    # (auto trusts only gated shapes — pallas_config_key): the Higgs
+    # headline (F=28 B=256 S=25), the slots=51 sweep, the max_bin=63
+    # GPU-config companion, plus a u16 wide-bin class for the cb=2 path.
+    for N, F, B, S, dtype, maxc in [
+            (scale, 28, 256, 25, np.uint8, 256),      # headline
+            (scale, 28, 256, 51, np.uint8, 256),      # slots sweep
+            (scale, 28, 64, 25, np.uint8, 64),        # B=63 companion
+            (scale // 2, 12, 512, 8, np.uint16, 512),  # u16 path
     ]:
+        cb = 1 if dtype == np.uint8 else 2
+        key = pallas_config_key(cb, B, S, F, 5)   # sweep runs hilo (ch=5)
+        name = key
+        config_fails = 0
+        config_rel = 0.0
         X = jnp.asarray(rng.randint(0, maxc, size=(N, F)).astype(dtype))
         g = jnp.asarray(rng.randn(N).astype(np.float32))
         h = jnp.asarray(np.abs(rng.randn(N)).astype(np.float32))
@@ -89,39 +103,47 @@ def run_gate(write_marker=True):
                 print(f"FAIL {name} compact={compact}: {str(e)[:300]}",
                       flush=True)
                 failures += 1
+                config_fails += 1
                 continue
             # f32 sums accumulated in different orders: tolerate tiny drift
             err = np.max(np.abs(out - ref))
             rel = err / max(np.max(np.abs(ref)), 1.0)
             ok = rel < 1e-5
-            worst_rel = max(worst_rel, float(rel))
+            config_rel = max(config_rel, float(rel))
             print(f"{'OK  ' if ok else 'FAIL'} {name} compact={compact}: "
                   f"max_abs_err={err:.3e} rel={rel:.3e}", flush=True)
             failures += 0 if ok else 1
+            config_fails += 0 if ok else 1
+        if config_fails == 0:
+            validated.append(key)
+            # worst_rel pins what was PROVEN: validated classes only
+            worst_rel = max(worst_rel, config_rel)
 
-    print("PALLAS ON-CHIP:", "ALL OK — auto resolves to pallas"
-          if failures == 0 else f"{failures} FAILURES — auto stays xla")
+    print("PALLAS ON-CHIP:", f"{len(validated)}/4 shape classes validated "
+          f"({failures} check failures) — auto resolves per shape:",
+          validated)
     marker = pallas_gate_marker_path()
-    if failures and on_hardware and os.path.exists(marker):
+    if not validated and on_hardware and os.path.exists(marker):
         # a marker from an older (passing) libtpu must not outlive a
         # failing re-run — that is exactly the hazard the gate exists for
         os.remove(marker)
         print("stale marker removed:", marker)
-    if failures == 0 and on_hardware and write_marker:
+    if validated and on_hardware and write_marker:
         with open(marker + ".tmp", "w") as fh:
             json.dump({
                 "device": str(jax.devices()[0]),
                 "jax": jax.__version__,
                 "libtpu": _libtpu_version(),
                 "kernel_src": pallas_kernel_source_hash(),
+                "configs": validated,
                 "worst_rel_err": worst_rel,
                 "utc": datetime.datetime.utcnow().isoformat(
                     timespec="seconds"),
             }, fh)
         os.replace(marker + ".tmp", marker)
         print("marker written:", marker)
-    return failures
+    return {"failures": failures, "validated": validated}
 
 
 if __name__ == "__main__":
-    sys.exit(1 if run_gate() else 0)
+    sys.exit(0 if run_gate()["validated"] else 1)

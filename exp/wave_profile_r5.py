@@ -1,0 +1,151 @@
+"""Round-5 wave-cost profile at the CURRENT bench shape (S=25, packed-u8
+row gather, per-feature Pallas kernel) — the measured decomposition
+VERDICT r4 #3 asked for. Successor of exp/wave_profile.py (round-3, S=16).
+
+Run: python -u exp/wave_profile_r5.py [quick]
+"""
+import time
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightgbm_tpu.utils.cache import enable_compile_cache, repo_cache_dir
+enable_compile_cache(repo_cache_dir())
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.grower import GrowerSpec, grow_tree
+from lightgbm_tpu.ops.histogram import (build_histograms, compact_rows,
+                                        pack_rows)
+from lightgbm_tpu.ops.pallas_histogram import build_histograms_pallas
+from lightgbm_tpu.ops.split_finder import per_feature_best_numerical
+
+N = 2 ** 21
+F = 28
+B = 256
+L = 255
+S = 25
+rng = np.random.RandomState(0)
+quick = "quick" in sys.argv[1:]
+print("backend:", jax.default_backend(), jax.devices()[0], flush=True)
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).sum()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).sum()
+    return (time.perf_counter() - t0) / reps
+
+
+def report(label, t):
+    print(f"{label:<52}: {t*1e3:8.2f} ms", flush=True)
+
+
+X = rng.randint(0, B, size=(N, F)).astype(np.uint8)
+Xd = jnp.asarray(X)
+g = jnp.asarray(rng.randn(N).astype(np.float32))
+h = jnp.ones(N, jnp.float32)
+inc = jnp.ones(N, jnp.float32)
+num_bins = jnp.full(F, B, jnp.int32)
+missing_code = jnp.zeros(F, jnp.int32)
+default_bin = jnp.zeros(F, jnp.int32)
+fok = jnp.ones(F, bool)
+is_cat = jnp.zeros(F, bool)
+
+# 32 pseudo-leaves so fractions of 1/32 are selectable
+leaf_id = jnp.asarray(rng.randint(0, 32, size=N).astype(np.int32))
+perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+chunk = 32768
+
+packed, _ = pack_rows(Xd, g, h, inc, True)
+
+# ---- 0. primitives ---------------------------------------------------------
+t = timeit(jax.jit(lambda p: jnp.take(packed, p, axis=0)), perm)
+report("0. packed row gather (2M x 38B)", t)
+t = timeit(jax.jit(lambda x: jnp.argsort(x, stable=True)), leaf_id)
+report("0. stable argsort (2M i32)", t)
+
+# ---- 1. full pass ----------------------------------------------------------
+slot_all = jnp.full(L + 1, -1, jnp.int32).at[jnp.arange(S)].set(jnp.arange(S))
+t = timeit(jax.jit(lambda lid: build_histograms(
+    Xd, g, h, inc, lid, slot_all, num_slots=S, num_bins_padded=B,
+    chunk_rows=chunk, packed=packed, code_mode="u8")), leaf_id)
+report("1. full-pass hist XLA", t)
+for pc in ([512, 1024] if not quick else [512]):
+    t = timeit(jax.jit(lambda lid, pc=pc: build_histograms_pallas(
+        Xd, g, h, inc, lid, slot_all, num_slots=S, num_bins_padded=B,
+        chunk_rows=pc, packed=packed)), leaf_id)
+    report(f"2. full-pass hist PALLAS chunk={pc}", t)
+
+# ---- 3. compacted at fractions --------------------------------------------
+for n_pend in ([16, 8, 4, 1] if not quick else [8]):
+    slot = jnp.full(L + 1, -1, jnp.int32).at[
+        jnp.arange(n_pend)].set(jnp.arange(n_pend))
+    frac = n_pend / 32
+
+    def compact_fix(lid, slot):
+        # the grower's stable-argsort slot-grouping (grower.py wave loop)
+        sl = slot[lid]
+        order = jnp.argsort(jnp.where(sl >= 0, sl, jnp.int32(2 ** 30)),
+                            stable=True).astype(jnp.int32)
+        cnts = jnp.bincount(jnp.where(sl >= 0, sl, S),
+                            length=S + 1)[:S].astype(jnp.int32)
+        return order, jnp.sum((sl >= 0).astype(jnp.int32)), cnts
+
+    def run_xla(lid, slot=slot):
+        ri, na, cnts = compact_fix(lid, slot)
+        return build_histograms(Xd, g, h, inc, lid, slot, num_slots=S,
+                                num_bins_padded=B, chunk_rows=chunk,
+                                row_idx=ri, n_active=na, slot_counts=cnts,
+                                packed=packed, code_mode="u8")
+
+    def run_pl(lid, slot=slot):
+        ri, na, cnts = compact_fix(lid, slot)
+        return build_histograms_pallas(
+            Xd, g, h, inc, lid, slot, num_slots=S, num_bins_padded=B,
+            chunk_rows=512, row_idx=ri, n_active=na, slot_counts=cnts,
+            packed=packed, max_rows=N)
+    t = timeit(jax.jit(run_xla), leaf_id)
+    report(f"3. compact hist XLA    ~{frac:4.0%} active", t)
+    t = timeit(jax.jit(run_pl), leaf_id)
+    report(f"3. compact hist PALLAS ~{frac:4.0%} active", t)
+
+# ---- 4/5. compaction alone; split scan -------------------------------------
+t = timeit(jax.jit(lambda lid: compact_rows(lid, slot_all)), leaf_id)
+report("4. compact_rows (cumsum+scatter form) alone", t)
+
+hist = jnp.asarray(rng.rand(2 * S, F, B, 3).astype(np.float32))
+pg = jnp.sum(hist[:, 0, :, 0], axis=-1)
+phs = jnp.sum(hist[:, 0, :, 1], axis=-1)
+pc_ = jnp.sum(hist[:, 0, :, 2], axis=-1)
+t = timeit(jax.jit(lambda hh: per_feature_best_numerical(
+    hh, pg, phs, pc_, num_bins, missing_code, default_bin, fok,
+    lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=100.0,
+    min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0)), hist)
+report(f"5. split scan 2S={2*S} slots", t)
+
+# ---- 6. grow_tree end-to-end ----------------------------------------------
+configs = [("xla", chunk), ("pallas", 512), ("mixed", chunk)]
+for kern, ck in configs:
+    try:
+        spec = GrowerSpec(num_leaves=L, num_features=F, num_bins_padded=B,
+                          chunk_rows=ck, hist_slots=S, wave_size=S,
+                          max_depth=0, lambda_l1=0.0, lambda_l2=0.0,
+                          min_data_in_leaf=100.0,
+                          min_sum_hessian_in_leaf=1e-3,
+                          min_gain_to_split=0.0, row_compact=True,
+                          hist_kernel=kern)
+        grow = jax.jit(lambda gg, spec=spec: grow_tree(
+            Xd, gg, h, inc, fok, is_cat, num_bins, missing_code,
+            default_bin, spec))
+        t = timeit(grow, g, reps=3)
+        report(f"6. grow_tree {kern:<6} slots={S}", t)
+        print(f"   -> {N / t / 1e6:6.1f} Mrow-tree/s (baseline 22.0)",
+              flush=True)
+    except Exception as e:                                    # noqa: BLE001
+        print(f"6. grow_tree {kern}: FAIL {str(e)[:200]}", flush=True)
+print("done", flush=True)

@@ -27,22 +27,22 @@ echo "=== 1. QUICK bench (2.1M rows; sparse phase deferred to step 3) ==="
 LGBM_TPU_BENCH_ROWS=2100000 LGBM_TPU_BENCH_SPARSE=0 \
   LGBM_TPU_BENCH_TIMEOUT=900 timeout 1000 \
   python bench.py | tee exp/BENCH_local_r5_quick.json
-echo "=== 2. pallas equality ON-CHIP (gate for auto->pallas) ==="
-rm -f exp/PALLAS_ONCHIP_OK   # a stale marker from a previous run must not
-                             # un-gate this run's pallas bench
+echo "=== 2. pallas equality ON-CHIP (per-shape gate; writes the marker"
+echo "       auto consults — exit 0 just means SOME shape validated) ==="
+rm -f exp/PALLAS_ONCHIP_OK
 if timeout 1200 python -u exp/pallas_onchip_check.py; then
   touch exp/PALLAS_ONCHIP_OK
-  echo "PALLAS GATE: PASS"
+  echo "PALLAS GATE: some shape classes validated (see marker configs)"
 else
-  echo "PALLAS GATE: FAIL (auto stays xla)"
+  echo "PALLAS GATE: nothing validated (auto stays xla)"
 fi
-echo "=== 3. full bench (10.5M, auto) ==="
+echo "=== 3. full bench (10.5M, auto -> mixed on gated shapes) ==="
 LGBM_TPU_BENCH_TIMEOUT=2700 timeout 2900 python bench.py | tee exp/BENCH_local_r5.json
 if [ -f exp/PALLAS_ONCHIP_OK ]; then
-  echo "=== 4. full bench kernel=pallas ==="
-  LGBM_TPU_BENCH_KERNEL=pallas LGBM_TPU_BENCH_SPARSE=0 \
+  echo "=== 4. full bench kernel=xla (comparison vs step 3's mixed) ==="
+  LGBM_TPU_BENCH_KERNEL=xla LGBM_TPU_BENCH_SPARSE=0 \
     LGBM_TPU_BENCH_TIMEOUT=1800 timeout 2000 \
-    python bench.py | tee exp/BENCH_local_r5_pallas.json
+    python bench.py | tee exp/BENCH_local_r5_xla.json
 fi
 echo "=== 5a. bench slots=51 (two rhs MXU tiles, half the waves) ==="
 LGBM_TPU_BENCH_SLOTS=51 LGBM_TPU_BENCH_SPARSE=0 \

@@ -154,40 +154,6 @@ class GBDT:
         n_for_pad = N if self._block_counts is None else \
             max(self._block_counts) * len(self._block_counts)
         per_target = max((n_for_pad + Drow - 1) // Drow, 1)
-        # "auto" kernel: the Pallas VMEM-accumulator kernel once it has
-        # passed its equality check on real hardware (the on-chip gate,
-        # exp/pallas_onchip_check.py, writes a marker checked by
-        # pallas_validated_on_chip — the analog of the reference's
-        # GPU_DEBUG_COMPARE, gpu_tree_learner.cpp:1018-1043); the XLA
-        # one-hot matmul otherwise (CPU backends, or un-gated libtpu —
-        # Mosaic lowering can differ from interpret mode). Opt in/out
-        # explicitly with tpu_hist_kernel=pallas|xla.
-        hist_kernel = config.tpu_hist_kernel
-        if hist_kernel == "auto":
-            from ..utils.cache import pallas_validated_on_chip
-            hist_kernel = ("pallas" if pallas_validated_on_chip()
-                           else "xla")
-            Log.debug("tpu_hist_kernel=auto resolved to %s", hist_kernel)
-        if config.tpu_hist_f64 and hist_kernel == "pallas":
-            Log.warning("tpu_hist_f64 requires the xla histogram kernel; "
-                        "overriding tpu_hist_kernel=pallas")
-            hist_kernel = "xla"
-        chunk = min(config.tpu_hist_chunk, _round_up(per_target, 256))
-        if hist_kernel == "pallas":
-            # measured fastest grid step AND safely inside the 16MB scoped
-            # VMEM limit (2048-row chunks OOM the in-kernel one-hot
-            # intermediates; exp/chain_profile.py)
-            chunk = min(chunk, 512)
-        Npad = _round_up(per_target, chunk) * Drow
-        self.num_data = N
-        self.num_data_padded = Npad
-        if (self._block_counts is not None and self.objective is not None
-                and hasattr(self.objective, "set_row_layout")):
-            # pre-partition: real rows sit at interleaved block positions,
-            # not [0, N) — give structured objectives (lambdarank) the
-            # global-row -> device-position map so their gathers stay valid
-            self.objective.set_row_layout(
-                np.asarray(self._real_rows()), Npad)
 
         meta = train_set.feature_meta_arrays()
         num_leaves = config.max_leaves_by_depth
@@ -243,13 +209,82 @@ class GBDT:
                              "(%d max bundle bins)", F, plan.num_groups,
                              plan.max_bundle_bins)
 
+        # ---- histogram kernel choice (needs the FINAL kernel shape class,
+        #      hence after EFB planning). "auto": the Pallas VMEM-accumulator
+        #      kernel iff the on-chip gate (exp/pallas_onchip_check.py — the
+        #      analog of the reference's GPU_DEBUG_COMPARE,
+        #      gpu_tree_learner.cpp:1018-1043) validated THIS shape class on
+        #      this machine's libtpu; the XLA one-hot matmul otherwise (CPU
+        #      backends, un-gated libtpu, or shapes the gate never ran —
+        #      Mosaic lowering failures are shape-triggered, round-5 gate
+        #      log). Opt in/out explicitly with tpu_hist_kernel=pallas|xla.
+        # auto slots: 25 x 5 bf16 channels = 125 matmul columns — one full
+        # MXU tile (128) — while quartering the wave count at 255 leaves.
+        # User-set slot counts clamp to the leaf budget: the wave loop's
+        # top_k over [num_leaves+1] gains requires S <= num_leaves.
+        slots = config.tpu_hist_slots or max(1, min(25, num_leaves - 1))
+        slots = max(1, min(slots, num_leaves))
+        # single source for the kernel shape class (cols_pad / Bb_pad are
+        # REUSED by the bundle materialization below — recomputing them
+        # there risked the gate key and the dispatched shape diverging)
+        if bundle_plan is not None:
+            # feature-parallel partitions BUNDLE blocks: G % devices == 0
+            cols_pad = (self.pctx.pad_features_to(bundle_plan.X_bundled.shape[1])
+                        if self.pctx.strategy == "feature"
+                        else bundle_plan.X_bundled.shape[1])
+            _kbins, _kdtype = Bb_pad, bundle_plan.X_bundled.dtype
+        else:
+            cols_pad = F_pad
+            _kbins, _kdtype = Bpad, train_set.X_binned.dtype
+        _kcols = cols_pad
+        if self.pctx.strategy == "feature" and self.pctx.num_devices > 1:
+            _kcols //= self.pctx.num_devices  # per-device column block
+        chunk = min(config.tpu_hist_chunk, _round_up(per_target, 256))
+        hist_kernel = config.tpu_hist_kernel
+        if hist_kernel == "auto":
+            from ..ops.histogram import code_bytes
+            from ..utils.cache import (pallas_config_key,
+                                       pallas_validated_on_chip)
+            key = pallas_config_key(code_bytes(np.dtype(_kdtype)),
+                                    int(_kbins), int(slots), int(_kcols),
+                                    5 if config.tpu_hist_hilo else 3)
+            # the gate ran its equality sweep at the 512-row grid step;
+            # datasets too small to fill one — including on the grower's
+            # row-compact path, whose buffer is capped at N/4 and would
+            # shrink the grid step below 512 when per_target < 2048 —
+            # are xla (and perf-irrelevant)
+            chunk_ok = chunk >= 512 and per_target >= 2048
+            # measured-best dispatch on gated shapes is MIXED: XLA for the
+            # streaming full passes, pallas for compacted ones
+            # (exp/kern_bench_r5.py shootout)
+            hist_kernel = ("mixed" if chunk_ok and config.tpu_row_compact
+                           and pallas_validated_on_chip(key) else "xla")
+            Log.debug("tpu_hist_kernel=auto resolved to %s (config %s)",
+                      hist_kernel, key)
+        if config.tpu_hist_f64 and hist_kernel in ("pallas", "mixed"):
+            Log.warning("tpu_hist_f64 requires the xla histogram kernel; "
+                        "overriding tpu_hist_kernel=%s", hist_kernel)
+            hist_kernel = "xla"
+        if hist_kernel == "pallas":
+            # measured fastest grid step AND safely inside the 16MB scoped
+            # VMEM limit (2048-row chunks OOM the in-kernel one-hot
+            # intermediates; exp/chain_profile.py)
+            chunk = min(chunk, 512)
+        Npad = _round_up(per_target, chunk) * Drow
+        self.num_data = N
+        self.num_data_padded = Npad
+        if (self._block_counts is not None and self.objective is not None
+                and hasattr(self.objective, "set_row_layout")):
+            # pre-partition: real rows sit at interleaved block positions,
+            # not [0, N) — give structured objectives (lambdarank) the
+            # global-row -> device-position map so their gathers stay valid
+            self.objective.set_row_layout(
+                np.asarray(self._real_rows()), Npad)
+
         self._num_bundles_padded = 0
         if bundle_plan is not None:
-            Bb_pad = max(8, _round_up(bundle_plan.max_bundle_bins, 8))
+            # Bb_pad / cols_pad fixed above, with the kernel shape class
             Xb = bundle_plan.X_bundled
-            # feature-parallel partitions BUNDLE blocks: G % devices == 0
-            cols_pad = (self.pctx.pad_features_to(Xb.shape[1])
-                        if self.pctx.strategy == "feature" else Xb.shape[1])
             self._num_bundles_padded = cols_pad
             fpad = F_pad - F
             ub = np.pad(bundle_plan.unpack_bin,
@@ -265,7 +300,6 @@ class GBDT:
             self._hist_bins = Bb_pad
         else:
             Xb = train_set.X_binned
-            cols_pad = F_pad
             self._hist_bins = 0
         # device placement of the (possibly bundled) code matrix: rows padded
         # to Npad (equal per-process blocks under pre-partition, where only
@@ -302,17 +336,13 @@ class GBDT:
         from ..ops.histogram import code_mode_for, default_code_mode
         max_code = (bundle_plan.max_bundle_bins if bundle_plan is not None
                     else train_set.max_num_bin)
-        if hist_kernel == "pallas":
+        if hist_kernel in ("pallas", "mixed"):
             code_mode = default_code_mode(Xb.dtype)
         else:
             code_mode = code_mode_for(int(max_code), Xb.dtype)
 
-        # auto slots: 25 x 5 bf16 channels = 125 matmul columns — one full
-        # MXU tile (128) — while quartering the wave count at 255 leaves.
-        # User-set slot counts clamp to the leaf budget: the wave loop's
-        # top_k over [num_leaves+1] gains requires S <= num_leaves.
-        slots = config.tpu_hist_slots or max(1, min(25, num_leaves - 1))
-        slots = max(1, min(slots, num_leaves))
+        # slots were fixed alongside the kernel choice (they are part of
+        # the gated kernel shape class)
         wave = config.tpu_wave_size or slots
         self.spec = GrowerSpec(
             num_leaves=num_leaves,

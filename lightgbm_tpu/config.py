@@ -348,8 +348,8 @@ class Config:
             Log.fatal("Unknown boosting type %s", self.boosting_type)
         if self.tree_learner not in ("serial", "feature", "data", "voting"):
             Log.fatal("Unknown tree learner type %s", self.tree_learner)
-        if self.tpu_hist_kernel not in ("auto", "xla", "pallas"):
-            Log.fatal("Unknown tpu_hist_kernel %s (auto|xla|pallas)",
+        if self.tpu_hist_kernel not in ("auto", "xla", "pallas", "mixed"):
+            Log.fatal("Unknown tpu_hist_kernel %s (auto|xla|pallas|mixed)",
                       self.tpu_hist_kernel)
         if self.boosting_type in ("rf", "random_forest"):
             # reference: rf.hpp:18-29 — bagging is mandatory for random forest

@@ -297,7 +297,7 @@ def grow_tree(
     # products at Precision.HIGHEST + Kahan chunk carry in build_histograms).
     # Guard at the mechanism: the pallas kernel unpacks packed weights as
     # bf16 unconditionally, so f32-mode rows would silently decode garbage
-    assert not (spec.hist_f64 and spec.hist_kernel == "pallas"), \
+    assert not (spec.hist_f64 and spec.hist_kernel in ("pallas", "mixed")), \
         "tpu_hist_f64 requires the xla histogram kernel"
     wmode = "f32" if spec.hist_f64 else spec.hist_hilo
     if spec.row_compact:
@@ -342,12 +342,22 @@ def grow_tree(
         # (reference data_parallel_tree_learner.cpp:148-163), identity
         # otherwise; output covers this device's feature block only.
         def hist_pass(row_idx, n_active, slot_counts=None):
-            if spec.hist_kernel == "pallas":
+            # "mixed" (the round-5 measured-best dispatch): the XLA one-hot
+            # matmul for FULL streaming passes (33.7 ms vs pallas 55/39 at
+            # 2M rows) and the Pallas VMEM-accumulator kernel for COMPACTED
+            # passes (18.0 vs 22.1 ms at 25% active) — exp/kern_bench_r5.py
+            use_pallas = (spec.hist_kernel == "pallas"
+                          or (spec.hist_kernel == "mixed"
+                              and row_idx is not None))
+            if use_pallas:
                 from .ops.pallas_histogram import build_histograms_pallas
                 return build_histograms_pallas(
                     X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
                     num_slots=S, num_bins_padded=B_hist,
-                    chunk_rows=spec.chunk_rows, row_idx=row_idx,
+                    # mixed leaves spec.chunk_rows at the XLA path's large
+                    # streaming chunk; the pallas grid step is its own knob
+                    chunk_rows=min(spec.chunk_rows, 512),
+                    row_idx=row_idx,
                     n_active=n_active, hilo=spec.hist_hilo,
                     slot_counts=slot_counts, packed=packed_rows,
                     # the adaptive cond only takes this path when

@@ -57,7 +57,7 @@ def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
                                       # accumulator (constant index_map keeps
                                       # the block resident across grid steps)
                  *, chunk_rows: int, num_bins: int, num_features: int,
-                 num_slots: int, cb: int, f_block: int = 4):
+                 num_slots: int, cb: int):
     i = pl.program_id(0)
     acc_ref = out_ref
 
@@ -69,32 +69,47 @@ def _hist_kernel(n_active_ref,        # SMEM scalar prefetch: [1] i32
     @pl.when(i * chunk_rows < n_active_ref[0])
     def _compute():
         # slot-weight columns built IN VMEM (never round-tripped via HBM):
-        # rhs[r, s*ch+c] = (slot[r]==s) * w[r, c]
+        # rhs[r, s*ch+c] = (slot[r]==s) * w[r, c]. The accumulator's row
+        # count is SC padded up to the f32 sublane tile (8) — Mosaic
+        # rejects a [125, ...] block (S=25 x ch=5, the default-slot
+        # config) outright; padded columns map to slot id >= num_slots,
+        # which no row carries, so they stay zero and the caller slices
+        # them off.
         ch = w_ref.shape[1]
+        sc_pad = acc_ref.shape[0]
         slot = slot_ref[:]                                 # [R, 1]
         iota_s = jax.lax.broadcasted_iota(
-            jnp.int32, (chunk_rows, num_slots * ch), 1) // ch
-        rhs = ((slot == iota_s).astype(jnp.bfloat16)
-               * jnp.tile(w_ref[:], (1, num_slots)))       # [R, SC]
+            jnp.int32, (chunk_rows, sc_pad), 1) // ch
+        w_rep = jnp.tile(w_ref[:], (1, -(-sc_pad // ch)))[:, :sc_pad]
+        rhs = (slot == iota_s).astype(jnp.bfloat16) * w_rep   # [R, SC_pad]
 
-        for f0 in range(0, num_features, f_block):
-            fb = min(f_block, num_features - f0)
-            # unpack fb features' code bytes, one-hot them: [R, fb*B]
+        # One feature per step: the one-hot is a BROADCAST compare of the
+        # feature column [R, 1] against a bin iota [R, B] — one VPU op per
+        # one-hot element. The earlier f-blocked form first materialized
+        # [R, fb*B] i32 via jnp.repeat and compared against iota%B, i.e.
+        # 3-4 VPU passes over the same elements; the one-hot build is the
+        # measured VPU bottleneck of this kernel (exp/RESULTS.md round-3
+        # cost model), so the extra passes were the pass-level gap vs the
+        # MXU floor. Per-feature [R, B] contractions keep the MXU busy at
+        # B >= 128 (2 lane tiles at B=256).
+        iota_b = jax.lax.broadcasted_iota(
+            jnp.int32, (chunk_rows, num_bins), 1)
+        for f in range(num_features):
             if cb == 1:
-                xs = x_ref[:, f0:f0 + fb].astype(jnp.int32)   # [R, fb]
+                xs = x_ref[:, f:f + 1].astype(jnp.int32)      # [R, 1]
             else:
-                # little-endian byte pairs (matches pack_rows' bitcast)
-                pair = x_ref[:, 2 * f0:2 * (f0 + fb)].astype(jnp.int32)
-                xs = pair[:, 0::2] | (pair[:, 1::2] << 8)     # [R, fb]
-            xb = jnp.repeat(xs, num_bins, axis=1)          # [R, fb*B]
-            iota_b = jax.lax.broadcasted_iota(
-                jnp.int32, (chunk_rows, fb * num_bins), 1) % num_bins
-            onehot = (xb == iota_b).astype(jnp.bfloat16)
+                # little-endian byte pair, two contiguous 1-column slices
+                # (a stride-2 lane slice is lowered as a gather Mosaic
+                # fails to shape-check — round-5 on-chip gate log)
+                xs = (x_ref[:, 2 * f:2 * f + 1].astype(jnp.int32)
+                      | (x_ref[:, 2 * f + 1:2 * f + 2].astype(jnp.int32)
+                         << 8))                               # [R, 1]
+            onehot = (xs == iota_b).astype(jnp.bfloat16)      # [R, B]
             part = jax.lax.dot_general(
                 rhs, onehot,
                 dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)        # [SC, fb*B]
-            sl = slice(f0 * num_bins, (f0 + fb) * num_bins)
+                preferred_element_type=jnp.float32)           # [SC_pad, B]
+            sl = slice(f * num_bins, (f + 1) * num_bins)
             acc_ref[:, sl] += part
 
 
@@ -118,6 +133,9 @@ def hist_pallas(
     ch = w.shape[1]
     hilo = ch == NUM_CHANNELS
     SC = num_slots * ch
+    # f32 sublane-tile alignment for the accumulator block (see the
+    # kernel's rhs comment): 125 -> 128 at the default S=25 x ch=5
+    SC_pad = -(-SC // 8) * 8
     assert N % chunk_rows == 0, (N, chunk_rows)
     if n_active is None:
         n_active = jnp.asarray(N, jnp.int32)
@@ -139,14 +157,14 @@ def hist_pallas(
                 pl.BlockSpec((chunk_rows, ch), lambda i, n: (i, 0)),
             ],
             out_specs=pl.BlockSpec(
-                (SC, num_features * num_bins), lambda i, n: (0, 0)),
+                (SC_pad, num_features * num_bins), lambda i, n: (0, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (SC, num_features * num_bins), jnp.float32),
+            (SC_pad, num_features * num_bins), jnp.float32),
         interpret=_INTERPRET,
     )(n_active.reshape(1), Xb8, slot.reshape(N, 1), w)
 
-    acc = out.reshape(num_slots, ch, num_features, num_bins)
+    acc = out[:SC].reshape(num_slots, ch, num_features, num_bins)
     acc = jnp.transpose(acc, (0, 2, 3, 1))                        # [S, F, B, ch]
     return combine_channels(acc, hilo)                            # [S, F, B, 3]
 

@@ -61,9 +61,24 @@ def pallas_kernel_source_hash() -> str:
     return h.hexdigest()
 
 
-def pallas_validated_on_chip() -> bool:
+def pallas_config_key(code_bytes: int, num_bins: int, num_slots: int,
+                      num_features: int, num_channels: int = 5) -> str:
+    """Stable name for one kernel shape class — what the on-chip gate
+    validates and what ``tpu_hist_kernel=auto`` looks up. Mosaic lowering
+    failures observed in round 5 were shape-triggered (the S=25 x ch=5
+    accumulator, the cb=2 byte-combine), so trust is granted per shape,
+    not per kernel. The weight-channel count is part of the shape (the
+    accumulator is [S*ch padded, F*B]): tpu_hist_hilo=false runs ch=3
+    blocks the gate's default ch=5 sweep never executed."""
+    return (f"u{8 * code_bytes}_b{num_bins}_s{num_slots}"
+            f"_f{num_features}_c{num_channels}")
+
+
+def pallas_validated_on_chip(config_key=None) -> bool:
     """True iff the current backend is a real TPU AND the on-chip Pallas
-    equality gate has passed on this machine (the marker file exists).
+    equality gate has passed on this machine (the marker file exists) —
+    for ``config_key``'s shape class when the marker carries a per-config
+    list (round-5 gates onward; ``pallas_config_key`` builds keys).
 
     This is how ``tpu_hist_kernel=auto`` decides between the Pallas
     VMEM-accumulator kernel and the XLA one-hot-matmul fallback: the
@@ -90,8 +105,16 @@ def pallas_validated_on_chip() -> bool:
             meta = json.load(fh)
         # every pin must be present and match: jax, libtpu (Mosaic lives
         # there), and the kernel sources the gate actually executed
-        return (meta.get("jax") == jax.__version__
+        if not (meta.get("jax") == jax.__version__
                 and meta.get("libtpu") == _libtpu_version()
-                and meta.get("kernel_src") == pallas_kernel_source_hash())
+                and meta.get("kernel_src") == pallas_kernel_source_hash()):
+            return False
+        # markers without a per-config list predate this kernel revision
+        # and necessarily fail the kernel_src pin above — require the list
+        configs = meta.get("configs") or ()
+        if config_key is None:
+            # "did any shape class validate here" — exp/ tooling's probe
+            return bool(configs)
+        return config_key in configs
     except Exception:
         return False

@@ -105,6 +105,10 @@ def test_train_with_pallas_kernel_matches_xla():
     p_x = m_xla.predict(X)
     p_p = m_pl.predict(X)
     np.testing.assert_allclose(p_p, p_x, rtol=1e-4, atol=1e-5)
+    # mixed dispatch (xla full passes + pallas compacted passes) likewise
+    m_mx = lgb.train({**base, "tpu_hist_kernel": "mixed"},
+                     lgb.Dataset(X, label=y), num_boost_round=3)
+    np.testing.assert_allclose(m_mx.predict(X), p_x, rtol=1e-4, atol=1e-5)
 
 
 def test_fast_channels_close_to_hilo():
@@ -233,18 +237,27 @@ def test_auto_kernel_gated_by_onchip_marker(monkeypatch, tmp_path):
     marker = tmp_path / "ok.json"
     monkeypatch.setattr(cache, "pallas_gate_marker_path",
                         lambda: str(marker))
+    key = cache.pallas_config_key(1, 256, 25, 28, 5)
     pins = {"jax": jax.__version__, "libtpu": cache._libtpu_version(),
-            "kernel_src": cache.pallas_kernel_source_hash()}
+            "kernel_src": cache.pallas_kernel_source_hash(),
+            "configs": [key]}
     # CPU backend: auto stays xla even with the marker present
     marker.write_text(json.dumps(pins))
-    assert not cache.pallas_validated_on_chip()
-    # simulate a TPU backend: marker decides
+    assert not cache.pallas_validated_on_chip(key)
+    # simulate a TPU backend: marker decides, per shape class
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    assert cache.pallas_validated_on_chip()
+    assert cache.pallas_validated_on_chip(key)
+    assert cache.pallas_validated_on_chip()          # any-config probe
+    assert not cache.pallas_validated_on_chip(
+        cache.pallas_config_key(2, 512, 8, 12, 5))   # un-gated shape
+    # a pre-per-config marker (no configs list) blesses nothing
+    marker.write_text(json.dumps({k: v for k, v in pins.items()
+                                  if k != "configs"}))
+    assert not cache.pallas_validated_on_chip(key)
     # stale under a different jax, a different libtpu, or edited kernel code
     for bad in ({"jax": "0.0.0-other"}, {"libtpu": "other"},
                 {"kernel_src": "beef"}):
         marker.write_text(json.dumps({**pins, **bad}))
-        assert not cache.pallas_validated_on_chip(), bad
+        assert not cache.pallas_validated_on_chip(key), bad
     marker.unlink()
-    assert not cache.pallas_validated_on_chip()
+    assert not cache.pallas_validated_on_chip(key)
