@@ -1,0 +1,87 @@
+"""Which dtype should the XLA kernel's one-hot compare run in?
+
+The full streaming pass is the round-5 tree-cost driver (~6-7 of them
+per tree at 33.7 ms each). Its two element-proportional stages are the
+one-hot build (N*F*B compare+convert VPU ops) and the [R,F,B]x[R,SC]
+contraction. This isolates the one-hot-build dtype (i32 = current,
+bf16, u8 — codes < 256 are exact in all three) and the chunk size.
+
+Run: python -u exp/onehot_dtype_bench.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from lightgbm_tpu.utils.cache import enable_compile_cache, repo_cache_dir
+enable_compile_cache(repo_cache_dir())
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+N, F, B, SC = 2 ** 21, 28, 256, 128
+REPS = 6
+print("backend:", jax.default_backend(), jax.devices()[0], flush=True)
+
+rng = np.random.RandomState(0)
+X = jnp.asarray(rng.randint(0, B, size=(N, F)).astype(np.uint8))
+W = jnp.asarray(rng.randn(N, SC).astype(np.float32)).astype(jnp.bfloat16)
+
+
+def make_pass(cmp_dtype, chunk):
+    iota = jnp.arange(B)
+    if cmp_dtype == "i32":
+        iota_c = iota.astype(jnp.int32)[None, None, :]
+    elif cmp_dtype == "bf16":
+        iota_c = iota.astype(jnp.bfloat16)[None, None, :]
+    else:
+        iota_c = iota.astype(jnp.uint8)[None, None, :]
+
+    def one_pass(x, w):
+        def chunk_part(i):
+            xc = jax.lax.dynamic_slice_in_dim(x, i * chunk, chunk)
+            wc = jax.lax.dynamic_slice_in_dim(w, i * chunk, chunk)
+            if cmp_dtype == "i32":
+                oh = (xc.astype(jnp.int32)[:, :, None] == iota_c)
+            elif cmp_dtype == "bf16":
+                oh = (xc.astype(jnp.bfloat16)[:, :, None] == iota_c)
+            else:
+                oh = (xc[:, :, None] == iota_c)
+            oh = oh.astype(jnp.bfloat16)
+            return jax.lax.dot_general(
+                oh, wc, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        acc0 = jnp.zeros((F, B, SC), jnp.float32)
+        acc, _ = jax.lax.scan(lambda a, i: (a + chunk_part(i), ()),
+                              acc0, jnp.arange(N // chunk))
+        return acc
+
+    @jax.jit
+    def run(x, w):
+        def body(i, carry):
+            wc, s = carry
+            r = one_pass(x, wc).sum()
+            return (wc.at[0, 0].set((r * 1e-30).astype(wc.dtype)), s + r)
+        return jax.lax.fori_loop(0, REPS, body, (w, jnp.float32(0)))[1]
+
+    return run
+
+
+for chunk in (32768, 65536, 131072):
+    for cd in ("i32", "bf16", "u8"):
+        run = make_pass(cd, chunk)
+        try:
+            t0 = time.perf_counter()
+            run(X, W).block_until_ready()
+            comp = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            run(X, W).block_until_ready()
+            el = (time.perf_counter() - t0) / REPS * 1000
+            print(f"chunk {chunk:6d} cmp {cd:4s}: {el:7.1f} ms/pass "
+                  f"(compile {comp:.0f}s)", flush=True)
+        except Exception as e:                                # noqa: BLE001
+            print(f"chunk {chunk:6d} cmp {cd:4s}: FAIL {str(e)[:120]}",
+                  flush=True)
+print("done", flush=True)

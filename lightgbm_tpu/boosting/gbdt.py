@@ -224,43 +224,31 @@ class GBDT:
         # top_k over [num_leaves+1] gains requires S <= num_leaves.
         slots = config.tpu_hist_slots or max(1, min(25, num_leaves - 1))
         slots = max(1, min(slots, num_leaves))
-        # single source for the kernel shape class (cols_pad / Bb_pad are
-        # REUSED by the bundle materialization below — recomputing them
-        # there risked the gate key and the dispatched shape diverging)
+        # single source for the kernel shape (cols_pad / Bb_pad are REUSED
+        # by the bundle materialization below — recomputing them there
+        # risked the dispatched shape diverging from what was decided here)
         if bundle_plan is not None:
             # feature-parallel partitions BUNDLE blocks: G % devices == 0
             cols_pad = (self.pctx.pad_features_to(bundle_plan.X_bundled.shape[1])
                         if self.pctx.strategy == "feature"
                         else bundle_plan.X_bundled.shape[1])
-            _kbins, _kdtype = Bb_pad, bundle_plan.X_bundled.dtype
         else:
             cols_pad = F_pad
-            _kbins, _kdtype = Bpad, train_set.X_binned.dtype
-        _kcols = cols_pad
-        if self.pctx.strategy == "feature" and self.pctx.num_devices > 1:
-            _kcols //= self.pctx.num_devices  # per-device column block
         chunk = min(config.tpu_hist_chunk, _round_up(per_target, 256))
         hist_kernel = config.tpu_hist_kernel
         if hist_kernel == "auto":
-            from ..ops.histogram import code_bytes
-            from ..utils.cache import (pallas_config_key,
-                                       pallas_validated_on_chip)
-            key = pallas_config_key(code_bytes(np.dtype(_kdtype)),
-                                    int(_kbins), int(slots), int(_kcols),
-                                    5 if config.tpu_hist_hilo else 3)
-            # the gate ran its equality sweep at the 512-row grid step;
-            # datasets too small to fill one — including on the grower's
-            # row-compact path, whose buffer is capped at N/4 and would
-            # shrink the grid step below 512 when per_target < 2048 —
-            # are xla (and perf-irrelevant)
-            chunk_ok = chunk >= 512 and per_target >= 2048
-            # measured-best dispatch on gated shapes is MIXED: XLA for the
-            # streaming full passes, pallas for compacted ones
-            # (exp/kern_bench_r5.py shootout)
-            hist_kernel = ("mixed" if chunk_ok and config.tpu_row_compact
-                           and pallas_validated_on_chip(key) else "xla")
-            Log.debug("tpu_hist_kernel=auto resolved to %s (config %s)",
-                      hist_kernel, key)
+            # Round-5 end-to-end measurements picked XLA: at the pass
+            # level the pallas kernel only wins compacted passes near 25%
+            # active (18.0 vs 22.1 ms), but real trees compact at 3-12%
+            # active where its fixed-size skip-grid loses to the XLA
+            # path's dynamic trip count — grow_tree: xla 263 ms, mixed
+            # 286, all-pallas 306 (exp/RESULTS.md round-5 session). auto
+            # therefore resolves xla; pallas/mixed remain explicit knobs
+            # whose trusted shapes the per-config on-chip gate still
+            # records (exp/pallas_onchip_check.py, utils/cache.py).
+            hist_kernel = "xla"
+            Log.debug("tpu_hist_kernel=auto resolved to xla (measured "
+                      "end-to-end best, round-5)")
         if config.tpu_hist_f64 and hist_kernel in ("pallas", "mixed"):
             Log.warning("tpu_hist_f64 requires the xla histogram kernel; "
                         "overriding tpu_hist_kernel=%s", hist_kernel)
@@ -358,6 +346,7 @@ class GBDT:
             min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
             min_gain_to_split=config.min_gain_to_split,
             row_compact=config.tpu_row_compact,
+            compact_frac=config.tpu_compact_frac,
             hist_kernel=hist_kernel,
             hist_hilo=config.tpu_hist_hilo,
             hist_f64=config.tpu_hist_f64,
@@ -410,6 +399,14 @@ class GBDT:
         self.models: List[List] = []        # per iteration: list of K device TreeArrays
         self._num_leaves_dev: List = []     # per iteration: [K] device array
         self.iter_ = 0
+        # device-resident twins of the per-step host scalars: through a
+        # remote-device tunnel every host->device scalar costs a round
+        # trip (~120 ms/tree of the round-3..5 bench gap between
+        # grow_tree alone and a full boosting step, exp/RESULTS.md) — the
+        # step carries its own iteration counter and only re-uploads the
+        # shrinkage when a learning_rates schedule actually changes it
+        self._iter_dev = None               # i32, step output; None = resync
+        self._shrink_cache = (None, None)   # (float value, device scalar)
         self.best_iter: Dict[str, int] = {}
         self.best_score: Dict[str, float] = {}
         self._rng_key = self._put(
@@ -587,6 +584,11 @@ class GBDT:
                     vs.Xb = xb
 
         def step_body(score, valid_scores, bag_mask, key, it, shrinkage, *grads):
+            # key arrives RAW; folding by the device iteration counter here
+            # reproduces the former host-side fold_in(rng, iter_) stream
+            # exactly (fold_in is value-deterministic) with zero per-step
+            # host->device transfers
+            key = jax.random.fold_in(key, it)
             if custom_grads:
                 g, h = grads
             else:
@@ -631,7 +633,8 @@ class GBDT:
                 nleaves.append(tree.num_leaves)
             out_score = jnp.stack(new_scores)
             out_valid = tuple(tuple(v) for v in new_valid)
-            return out_score, out_valid, mask, tuple(trees), jnp.stack(nleaves)
+            return (out_score, out_valid, mask, tuple(trees),
+                    jnp.stack(nleaves), it + 1)
 
         # donate the score buffers (positions: score=2, valid_scores=3) —
         # they are rebound to the step's outputs immediately after every
@@ -652,14 +655,17 @@ class GBDT:
             if self._custom_step_fn is None:
                 self._custom_step_fn = self._make_step(custom_grads=True)
             fn, extra = self._custom_step_fn, custom_gh
-        key = jax.random.fold_in(self._rng_key, self.iter_)
+        if self._iter_dev is None:    # first step / post-rollback resync
+            self._iter_dev = jnp.asarray(self.iter_, jnp.int32)
+        if self._shrink_cache[0] != shrinkage:
+            self._shrink_cache = (shrinkage,
+                                  jnp.asarray(shrinkage, jnp.float32))
         valid_scores = tuple(tuple(vs.score[k] for k in range(self.num_models))
                              for vs in self.valid_sets)
         consts, valid_Xb = self._step_consts()
-        score, out_valid, self.bag_mask, trees, nl = fn(
-            consts, valid_Xb, score, valid_scores, self.bag_mask, key,
-            jnp.asarray(self.iter_, jnp.int32),
-            jnp.asarray(shrinkage, jnp.float32), *extra)
+        score, out_valid, self.bag_mask, trees, nl, self._iter_dev = fn(
+            consts, valid_Xb, score, valid_scores, self.bag_mask,
+            self._rng_key, self._iter_dev, self._shrink_cache[1], *extra)
         self.models.append(list(trees))
         self._num_leaves_dev.append(nl)
         self.iter_ += 1
@@ -722,6 +728,7 @@ class GBDT:
         trees = self.models.pop()
         self._num_leaves_dev.pop()
         self.iter_ -= 1
+        self._iter_dev = None           # device counter resyncs next step
         score = self.score
         new_scores = []
         for k, tree in enumerate(trees):
@@ -793,6 +800,7 @@ class GBDT:
             self.models.pop()
             self._num_leaves_dev.pop()
             self.iter_ -= 1
+            self._iter_dev = None       # device counter resyncs next step
             return True
         return False
 

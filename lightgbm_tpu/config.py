@@ -295,6 +295,8 @@ class Config:
     # prefix-compacted index gather (the analog of the reference's
     # smaller-leaf histogramming, serial_tree_learner.cpp:354-362)
     tpu_row_compact: bool = True
+    tpu_compact_frac: float = 0.25            # compact passes below this
+                                              # active-row fraction
     # histogram kernel: "auto" (currently = xla until the pallas path is
     # equality-checked on real hardware) | "xla" one-hot matmul | "pallas"
     # fused VMEM-accumulator kernel (ops/pallas_histogram.py, the OpenCL

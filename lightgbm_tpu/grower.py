@@ -131,6 +131,15 @@ class GrowerSpec:
     min_sum_hessian_in_leaf: float
     min_gain_to_split: float
     row_compact: bool = True      # histogram only pending-leaf rows per wave
+    compact_frac: float = 0.25    # compact when n_active < frac*N. The
+                                  # round-5 trace put the hist matmul at 92%
+                                  # MXU peak, so the remaining lever is the
+                                  # FLOP volume itself: a full streaming
+                                  # pass pays all N rows even at 30-50%
+                                  # active; compacting there trades a
+                                  # gather+argsort for a ~2x smaller matmul.
+                                  # The pallas kernel's skip-grid buffers
+                                  # are sized to N/4 — keep <= 0.25 there
     hist_bins: int = 0            # bin axis of the histogram BUILD (EFB bundle
                                   # space); 0 = num_bins_padded (unbundled)
     code_mode: Optional[str] = None  # packed-row code layout (histogram.py
@@ -392,10 +401,14 @@ def grow_tree(
                     .astype(jnp.int32), axis=0)
                 return hist_pass(row_idx, n_active, counts)
 
-            # N//4 is a static Python int, so the predicate cannot overflow
-            # int32 at any N — and it provably matches the pallas path's
-            # max_rows=(N+3)//4 buffer cap (n_active < N//4 <= (N+3)//4).
-            new_hist = jax.lax.cond(n_active < N // 4, compact_pass,
+            # the threshold is a static Python int, so the predicate cannot
+            # overflow int32 at any N. Pallas/mixed kernels keep the N/4
+            # cap regardless of compact_frac: their skip-grid buffers are
+            # provably sized by max_rows=(N+3)//4 (n_active < N//4).
+            frac = spec.compact_frac
+            if spec.hist_kernel in ("pallas", "mixed"):
+                frac = min(frac, 0.25)
+            new_hist = jax.lax.cond(n_active < int(N * frac), compact_pass,
                                     lambda: hist_pass(None, None))
         else:
             new_hist = hist_pass(None, None)

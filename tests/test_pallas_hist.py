@@ -224,10 +224,13 @@ def test_max_rows_capped_buffers_match():
 
 
 def test_auto_kernel_gated_by_onchip_marker(monkeypatch, tmp_path):
-    """tpu_hist_kernel=auto resolves to pallas ONLY when the on-chip gate
-    marker exists AND the backend is a real TPU (utils/cache.py
-    pallas_validated_on_chip) — the runtime analog of the reference gating
-    its GPU learner on GPU_DEBUG_COMPARE passing."""
+    """pallas_validated_on_chip trusts a kernel shape class ONLY when the
+    on-chip gate marker lists it, all pins match, AND the backend is a
+    real TPU (utils/cache.py) — the runtime analog of the reference
+    gating its GPU learner on GPU_DEBUG_COMPARE passing. (Round 5:
+    tpu_hist_kernel=auto resolves to xla on end-to-end measurement; the
+    marker remains the trust record for the explicit pallas/mixed knobs.)
+    """
     import json
 
     import jax
