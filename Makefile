@@ -10,6 +10,16 @@
 # compile cache in .jax_cache; `check-fast` is ~4 min cold.
 PYTEST := python -m pytest -q
 
+# Static JAX/TPU hygiene pass (rules R001-R006, see docs/Static-Analysis.md).
+# Exits non-zero on any finding not covered by tpu_lint_baseline.json.
+lint:
+	python -m lightgbm_tpu.analysis lightgbm_tpu/
+
+# CI gate: lint + tier-1 tests + the recompile guard on a 5-iter smoke run.
+verify: lint
+	env JAX_PLATFORMS=cpu $(PYTEST) tests/ -m 'not slow'
+	python bench.py --smoke
+
 check-fast:
 	$(PYTEST) tests/test_parallel.py tests/test_wave_parity.py \
 	          tests/test_engine.py::test_binary tests/test_engine.py::test_regression \
@@ -25,4 +35,4 @@ capi:
 bench-cpu:
 	LGBM_TPU_BENCH_ROWS=400000 JAX_PLATFORMS=cpu python bench.py
 
-.PHONY: check-fast check capi bench-cpu
+.PHONY: lint verify check-fast check capi bench-cpu

@@ -413,11 +413,18 @@ def run_bench(deadline, attempt=0, platform=None):
         bst.update()
     # force all queued work to finish before starting the clock
     np.asarray(bst._gbdt.score).sum()
-    t0 = time.perf_counter()
-    for _ in range(timed):
-        bst.update()
-    np.asarray(bst._gbdt.score).sum()
-    elapsed = time.perf_counter() - t0
+    # record-only recompile guard (fail=False: a recompile here is reported
+    # in the JSON, not a crash — `bench.py --smoke` is the enforcing run)
+    from lightgbm_tpu.analysis.guards import RecompileGuard
+    guard = RecompileGuard(label="bench", fail=False)
+    guard.register(bst._gbdt._step_fn, "train_step")
+    with guard:
+        guard.mark_warm()
+        t0 = time.perf_counter()
+        for _ in range(timed):
+            bst.update()
+        np.asarray(bst._gbdt.score).sum()
+        elapsed = time.perf_counter() - t0
     mrow_tree_per_s = n_rows * timed / elapsed / 1e6
 
     result = {
@@ -430,6 +437,7 @@ def run_bench(deadline, attempt=0, platform=None):
         "kernel": kernel_resolved,
         "attempt": attempt,
         **({"hist_slots": slots} if slots else {}),
+        "recompiles_post_warmup": guard.report()["post_warmup_cache_misses"],
         "auc": None,
         "auc_parity_gap": None,
     }
@@ -829,8 +837,55 @@ def main():
     print(json.dumps(result))
 
 
+def run_smoke():
+    """`bench.py --smoke`: hermetic-CPU 5-iteration training run under the
+    RecompileGuard (lightgbm_tpu/analysis/guards.py) — fails if the
+    steady-state train step recompiles after warm-up. The CI-enforced form
+    of the round-5 per-shape gate: shape/static leaks into the step
+    signature show up here as a nonzero miss count, before any TPU sees
+    them. Prints one JSON line; exit 0 iff the guard holds."""
+    from lightgbm_tpu.utils.hermetic import force_cpu_backend
+    force_cpu_backend()
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis.guards import GuardViolation, RecompileGuard
+
+    n_rows = int(os.environ.get("LGBM_TPU_SMOKE_ROWS", "20000"))
+    iters = int(os.environ.get("LGBM_TPU_SMOKE_ITERS", "5"))
+    X, y = _higgs_like(n_rows)
+    params = dict(objective="binary", num_leaves=31, max_bin=63,
+                  learning_rate=0.1, min_data_in_leaf=20, verbose=-1,
+                  metric="none", tpu_hist_kernel="xla")
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(2):            # warm-up: the compiles that are allowed
+        bst.update()
+    np.asarray(bst._gbdt.score).sum()
+
+    guard = RecompileGuard(label="smoke")
+    guard.register(bst._gbdt._step_fn, "train_step")
+    ok, err = True, None
+    try:
+        with guard:
+            guard.mark_warm()
+            for _ in range(iters):
+                bst.update()
+            np.asarray(bst._gbdt.score).sum()   # drain queued work
+    except GuardViolation as e:
+        ok, err = False, str(e)
+    report = guard.report()
+    out = {"metric": "smoke_recompile_guard", "rows": n_rows, "iters": iters,
+           "post_warmup_cache_misses": report["post_warmup_cache_misses"],
+           "host_syncs": report["host_syncs"], "ok": ok}
+    if err:
+        out["error"] = err[:300]
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "--sparse" in sys.argv:
         run_sparse_phase()
+    elif "--smoke" in sys.argv:
+        sys.exit(run_smoke())
     else:
         main()

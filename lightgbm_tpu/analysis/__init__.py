@@ -1,0 +1,15 @@
+"""Static + runtime hygiene tooling for the TPU GBDT codebase.
+
+Two halves (docs/Static-Analysis.md):
+
+- ``tpu_lint`` — an AST analyzer enforcing JAX/TPU hygiene rules R001-R006
+  (traced control flow, host syncs in hot paths, dtype-promotion hazards,
+  Pallas tiling contracts, bad static_argnums, import-time jnp execution).
+  CLI: ``python -m lightgbm_tpu.analysis lightgbm_tpu/``. Pure stdlib — it
+  never imports jax, so it runs anywhere in milliseconds.
+- ``guards`` — a runtime context manager that counts jit cache misses per
+  entrypoint and implicit host syncs, and fails when a steady-state
+  training loop recompiles after warm-up (bench.py --smoke, tests).
+"""
+from .guards import GuardViolation, RecompileGuard, recompile_guard  # noqa: F401
+from .tpu_lint import Finding, lint_paths, main  # noqa: F401

@@ -1,0 +1,5 @@
+"""`python -m lightgbm_tpu.analysis` — run tpu-lint."""
+from .tpu_lint import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

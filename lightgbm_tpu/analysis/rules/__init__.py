@@ -1,0 +1,18 @@
+"""Rule registry for tpu-lint. Each rule module exposes a class with
+``rule_id``, ``summary`` and ``check(ctx) -> Iterable[Finding]``."""
+from .control_flow import ControlFlowRule          # R001
+from .host_sync import HostSyncRule                # R002
+from .dtype_promotion import DtypePromotionRule    # R003
+from .pallas_shapes import PallasShapeRule         # R004
+from .static_args import StaticArgsRule            # R005
+from .import_exec import ImportExecRule            # R006
+
+_RULES = None
+
+
+def active_rules():
+    global _RULES
+    if _RULES is None:
+        _RULES = [ControlFlowRule(), HostSyncRule(), DtypePromotionRule(),
+                  PallasShapeRule(), StaticArgsRule(), ImportExecRule()]
+    return _RULES

@@ -1,0 +1,224 @@
+"""Shared AST helpers for the tpu-lint rules.
+
+Traced-value inference is deliberately conservative: a name is "traced"
+only when it demonstrably flows from a jnp./jax. array expression (or is a
+parameter of a function the tracer provably enters — jit-decorated,
+jit-wrapped, or passed to a jax.lax control-flow primitive). The goal is a
+near-zero false-positive rate on idiomatic host-side code; the baseline
+file absorbs the audited remainder.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+# dotted prefixes whose call results are jax arrays (tracer-carrying)
+TRACED_CALL_PREFIXES = (
+    "jnp.", "jax.numpy.", "jax.lax.", "jax.random.", "jax.nn.",
+    "lax.", "pl.", "pltpu.",
+)
+# jit entry wrappers
+JIT_NAMES = {"jax.jit", "jit", "jax.pmap", "pmap", "pjit", "jax.pjit"}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+# jax.lax primitives taking traced-callable arguments
+LAX_HOF = {
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.map", "lax.map",
+    "jax.lax.switch", "lax.switch",
+    "jax.lax.associative_scan", "lax.associative_scan",
+}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _const_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """(1, 2) / [1, 2] / 3 as a tuple of ints when fully static, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def jit_static_params(fn: ast.FunctionDef, jit_call: Optional[ast.Call]
+                      ) -> Set[str]:
+    """Parameter names marked static at a jit site (best effort)."""
+    if jit_call is None:
+        return set()
+    names = param_names(fn)
+    static: Set[str] = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            idxs = _const_int_tuple(kw.value) or ()
+            for i in idxs:
+                if 0 <= i < len(names):
+                    static.add(names[i])
+        elif kw.arg == "static_argnames":
+            if isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                static.add(kw.value.value)
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                for e in kw.value.elts:
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, str):
+                        static.add(e.value)
+    return static
+
+
+def jit_decorator_call(fn: ast.FunctionDef) -> Tuple[bool, Optional[ast.Call]]:
+    """(is_jit_decorated, the jit Call node carrying kwargs or None)."""
+    for dec in fn.decorator_list:
+        name = dotted_name(dec)
+        if name in JIT_NAMES:
+            return True, None
+        if isinstance(dec, ast.Call):
+            cname = dotted_name(dec.func)
+            if cname in JIT_NAMES:
+                return True, dec
+            if cname in PARTIAL_NAMES and dec.args:
+                if dotted_name(dec.args[0]) in JIT_NAMES:
+                    return True, dec
+    return False, None
+
+
+def iter_functions(tree: ast.Module) -> Iterable[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def traced_entry_functions(tree: ast.Module
+                           ) -> List[Tuple[ast.FunctionDef, Set[str]]]:
+    """Functions the tracer provably enters, with their static-param names.
+
+    Detected forms:
+    - ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators
+    - ``g = jax.jit(f, ...)`` / ``return jax.jit(f, ...)`` wrapping a
+      same-module ``def f``
+    - ``def body(...)`` passed by name to a jax.lax control-flow primitive
+      (while_loop/cond/scan/fori_loop/map/switch)
+    """
+    by_name = {}
+    for fn in iter_functions(tree):
+        by_name.setdefault(fn.name, fn)
+
+    out = []
+    seen = set()
+
+    def add(fn: ast.FunctionDef, jit_call: Optional[ast.Call]):
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        out.append((fn, jit_static_params(fn, jit_call)))
+
+    for fn in iter_functions(tree):
+        is_jit, jcall = jit_decorator_call(fn)
+        if is_jit:
+            add(fn, jcall)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = dotted_name(node.func)
+        if cname in JIT_NAMES and node.args:
+            target = dotted_name(node.args[0])
+            if target in by_name:
+                add(by_name[target], node)
+        elif cname in LAX_HOF:
+            for arg in node.args:
+                target = dotted_name(arg)
+                if target in by_name:
+                    add(by_name[target], None)
+    return out
+
+
+def expr_is_traced(expr: ast.AST, traced: Set[str]) -> bool:
+    """Does this expression reference a traced name or a jnp./jax. call?"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in traced:
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and (name.startswith(TRACED_CALL_PREFIXES)
+                         or name in JIT_NAMES):
+                return True
+    return False
+
+
+def _assign_targets(stmt: ast.AST) -> List[str]:
+    names = []
+
+    def collect(t):
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect(e)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            collect(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        collect(stmt.target)
+    return names
+
+
+def infer_traced_names(fn: ast.FunctionDef, params_traced: bool,
+                       static_params: Set[str] = frozenset()) -> Set[str]:
+    """Fixpoint dataflow: names holding (expressions derived from) jax
+    arrays inside ``fn``. Walks nested functions too — their assignments
+    only ever *add* traced names, which is the conservative direction."""
+    traced: Set[str] = set()
+    if params_traced:
+        traced |= set(param_names(fn)) - set(static_params)
+
+    assigns = [s for s in ast.walk(fn)
+               if isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign))]
+    changed = True
+    while changed:
+        changed = False
+        for stmt in assigns:
+            value = stmt.value
+            if value is None:
+                continue
+            if expr_is_traced(value, traced):
+                for name in _assign_targets(stmt):
+                    if name not in traced:
+                        traced.add(name)
+                        changed = True
+    return traced

@@ -1,0 +1,44 @@
+"""R001: Python control flow on traced values inside jit-entered functions.
+
+``if``/``while``/``assert`` on a traced value calls ``bool()`` on a tracer:
+at best a ConcretizationTypeError at trace time, at worst (with
+``static_argnums`` misuse or accidental concretization) a silent per-value
+recompile. Inside ``@jit``-decorated functions, ``jax.jit(f)``-wrapped
+defs, and callables handed to jax.lax control-flow primitives, branch on
+``jnp.where`` / ``lax.cond`` / ``lax.while_loop`` instead.
+"""
+from __future__ import annotations
+
+import ast
+
+from .common import expr_is_traced, infer_traced_names, traced_entry_functions
+
+RULE_ID = "R001"
+
+
+class ControlFlowRule:
+    rule_id = RULE_ID
+    summary = ("Python if/while/assert on a traced value inside a "
+               "jit-entered function (use jnp.where/lax.cond)")
+
+    def check(self, ctx):
+        for fn, static_params in traced_entry_functions(ctx.tree):
+            traced = infer_traced_names(fn, params_traced=True,
+                                        static_params=static_params)
+            if not traced:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                elif isinstance(node, ast.Assert):
+                    test = node.test
+                    kind = "assert"
+                else:
+                    continue
+                if expr_is_traced(test, traced):
+                    yield ctx.finding(
+                        self.rule_id, node,
+                        f"Python `{kind}` on a traced value in jit-entered "
+                        f"function `{fn.name}` — use jnp.where/jax.lax.cond "
+                        f"(or mark the argument static)")

@@ -1,0 +1,91 @@
+"""R003: dtype-promotion hazards around low-precision accumulators.
+
+Two shapes of the round-5 accuracy drift in ops/histogram.py:
+
+- a ``jnp.stack``/``jnp.concatenate`` whose inputs MIX explicit
+  ``.astype(...)`` casts with bare names: the bare inputs' dtype is
+  whatever upstream happened to produce, and jax's implicit promotion
+  silently widens (or narrows) the whole stack — the bf16 hi/lo packing
+  changes accuracy without any error. Cast every input explicitly.
+- arithmetic combining a name that was explicitly cast to ``bfloat16``
+  with a bare Python float literal: numpy scalars/f32 neighbours promote
+  the bf16 accumulator to f32, doubling its HBM footprint behind the
+  optimizer's back.
+"""
+from __future__ import annotations
+
+import ast
+
+from .common import dotted_name, iter_functions
+
+RULE_ID = "R003"
+
+_STACK_FNS = {"jnp.stack", "jnp.concatenate", "jax.numpy.stack",
+              "jax.numpy.concatenate"}
+
+
+def _is_astype_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype")
+
+
+def _bf16_cast_names(fn: ast.FunctionDef) -> set:
+    """Names assigned from an explicit `.astype(jnp.bfloat16)` cast."""
+    out = set()
+    for stmt in ast.walk(fn):
+        if not isinstance(stmt, ast.Assign) or not _is_astype_call(stmt.value):
+            continue
+        args = stmt.value.args
+        if args and dotted_name(args[0]) in ("jnp.bfloat16",
+                                             "jax.numpy.bfloat16"):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+class DtypePromotionRule:
+    rule_id = RULE_ID
+    summary = ("mixed explicit/implicit dtypes in jnp.stack inputs, or a "
+               "bare float literal widening a bfloat16 value")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and dotted_name(node.func) in _STACK_FNS and node.args \
+                    and isinstance(node.args[0], (ast.List, ast.Tuple)):
+                elts = node.args[0].elts
+                cast = [e for e in elts if _is_astype_call(e)]
+                bare = [e for e in elts
+                        if isinstance(e, (ast.Name, ast.Attribute))]
+                if cast and bare:
+                    names = ", ".join(sorted(
+                        dotted_name(e) or "<expr>" for e in bare))
+                    yield ctx.finding(
+                        self.rule_id, node,
+                        f"`{dotted_name(node.func)}` mixes explicit "
+                        f".astype(...) inputs with bare inputs ({names}) — "
+                        f"implicit promotion can silently change the "
+                        f"accumulator dtype; cast every input explicitly")
+
+        for fn in iter_functions(ctx.tree):
+            bf16 = _bf16_cast_names(fn)
+            if not bf16:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.BinOp):
+                    continue
+                sides = (node.left, node.right)
+                lit = [s for s in sides
+                       if isinstance(s, ast.Constant)
+                       and isinstance(s.value, float)]
+                name = [s for s in sides
+                        if isinstance(s, ast.Name) and s.id in bf16]
+                if lit and name:
+                    yield ctx.finding(
+                        self.rule_id, node,
+                        f"bare float literal {lit[0].value!r} in arithmetic "
+                        f"with bfloat16-cast `{name[0].id}` — promotion "
+                        f"widens the accumulator; use a typed scalar "
+                        f"(jnp.bfloat16({lit[0].value!r}))")

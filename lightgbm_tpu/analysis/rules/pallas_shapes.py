@@ -1,0 +1,110 @@
+"""R004: Pallas block shapes must satisfy the TPU sublane/lane tiling.
+
+Mosaic tiles vector memory as (sublane, lane) = (8, 128) for 4-byte types,
+(16, 128) for bf16, (32, 128) for 1-byte types. A ``pl.BlockSpec`` block or
+``pallas_call`` out_shape whose minor dim is not a multiple of 128, or
+whose second-minor dim is not sublane-aligned, is rejected at Mosaic
+lowering time — on real hardware only, long after CPU interpret-mode tests
+passed. Round 5's "125-row accumulator" (S=25 x ch=5 slot-channel rows)
+was exactly this: pad to the tile (``-(-n // 8) * 8``) and mask instead.
+
+Only statically-known integer dims are checked; dims spelled as names or
+arithmetic are assumed padded by the caller. The sublane requirement is
+checked with dtype-aware strictness for ``ShapeDtypeStruct`` (dtype is in
+the call) and with the weakest requirement (8) for ``BlockSpec``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .common import dotted_name
+
+RULE_ID = "R004"
+
+LANE = 128
+_SUBLANE = {"float32": 8, "int32": 8, "uint32": 8,
+            "bfloat16": 16, "float16": 16, "int16": 16, "uint16": 16,
+            "int8": 32, "uint8": 32, "bool_": 32}
+
+
+def _static_dims(node):
+    """[int or None, ...] for a literal tuple/list shape, else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    dims = []
+    for e in node.elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                and not isinstance(e.value, bool):
+            dims.append(e.value)
+        else:
+            dims.append(None)
+    return dims
+
+
+def _sublane_for(dtype_node) -> int:
+    name = dotted_name(dtype_node) or ""
+    leaf = name.rsplit(".", 1)[-1]
+    return _SUBLANE.get(leaf, 8)
+
+
+def _check_dims(dims, sublane):
+    """Yield (what, dim, requirement) misalignment descriptions."""
+    if not dims or len(dims) < 2:
+        return
+    minor, second = dims[-1], dims[-2]
+    if minor is not None and minor != 1 and minor % LANE:
+        yield ("minor (lane) dim", minor, LANE)
+    if second is not None and second != 1 and second % sublane:
+        yield ("second-minor (sublane) dim", second, sublane)
+
+
+class PallasShapeRule:
+    rule_id = RULE_ID
+    summary = ("pallas_call block / out_shape dims not aligned to the TPU "
+               "(sublane, lane) tile — Mosaic rejects them on hardware")
+
+    def check(self, ctx):
+        # ShapeDtypeStruct is a general jax utility (eval_shape etc.) — only
+        # instances inside a pallas_call(out_shape=...) subtree are
+        # tile-constrained. BlockSpec is pallas-specific, checked anywhere.
+        in_out_shape = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and (dotted_name(node.func) or "").endswith("pallas_call"):
+                for kw in node.keywords:
+                    if kw.arg == "out_shape":
+                        for sub in ast.walk(kw.value):
+                            in_out_shape.add(id(sub))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf == "BlockSpec":
+                if any(kw.arg == "memory_space" for kw in node.keywords):
+                    continue          # SMEM/ANY blocks are not vector-tiled
+                shape_node = node.args[0] if node.args else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "block_shape"), None)
+                for what, dim, req in _check_dims(
+                        _static_dims(shape_node), sublane=8):
+                    yield ctx.finding(
+                        self.rule_id, node,
+                        f"BlockSpec {what} = {dim} is not a multiple of "
+                        f"{req} — Mosaic rejects the block on hardware; "
+                        f"pad to the tile and mask")
+            elif leaf == "ShapeDtypeStruct" and id(node) in in_out_shape:
+                shape_node = node.args[0] if node.args else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "shape"), None)
+                dtype_node = node.args[1] if len(node.args) > 1 else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "dtype"), None)
+                sub = _sublane_for(dtype_node) if dtype_node is not None else 8
+                for what, dim, req in _check_dims(_static_dims(shape_node),
+                                                  sublane=sub):
+                    yield ctx.finding(
+                        self.rule_id, node,
+                        f"ShapeDtypeStruct {what} = {dim} is not a "
+                        f"multiple of {req} for this dtype — pad to the "
+                        f"(sublane, lane) tile and slice the result")

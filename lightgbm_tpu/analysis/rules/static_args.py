@@ -1,0 +1,135 @@
+"""R005: non-hashable or array-valued static_argnums.
+
+A ``static_argnums`` parameter is hashed and compared per call: passing a
+jax/numpy array there either raises (unhashable) or — worse, for small
+hashable proxies like tuples rebuilt per call — recompiles on every
+distinct value, which is the recompile-churn failure mode the runtime
+guard (guards.py) exists to catch. Flagged statically when:
+
+- the jit site's static parameter is used as an array in the function body
+  (passed to jnp./jax. ops, ``.astype``/``.at``/``.dtype`` access), or
+- the static parameter carries a mutable (unhashable) default, or
+- ``static_argnums``/``static_argnames`` is itself malformed (non-int /
+  non-str entries).
+"""
+from __future__ import annotations
+
+import ast
+
+from .common import (JIT_NAMES, PARTIAL_NAMES, dotted_name, iter_functions,
+                     param_names)
+
+RULE_ID = "R005"
+
+_ARRAY_ATTRS = {"astype", "at", "dtype", "reshape", "sum", "mean"}
+_JNP_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.")
+
+
+def _jit_sites(tree):
+    """Yield (jit Call node, target FunctionDef or None)."""
+    by_name = {}
+    for fn in iter_functions(tree):
+        by_name.setdefault(fn.name, fn)
+    deco_calls = set()
+    for fn in iter_functions(tree):
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call):
+                deco_calls.add(id(dec))
+                cname = dotted_name(dec.func)
+                if cname in JIT_NAMES:
+                    yield dec, fn
+                elif cname in PARTIAL_NAMES and dec.args \
+                        and dotted_name(dec.args[0]) in JIT_NAMES:
+                    yield dec, fn
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and id(node) not in deco_calls \
+                and dotted_name(node.func) in JIT_NAMES:
+            target = by_name.get(dotted_name(node.args[0])) if node.args else None
+            yield node, target
+
+
+def _static_param_names(call, fn):
+    """(names, malformed_entries) for the static args at this jit site."""
+    names, bad = [], []
+    plist = param_names(fn) if fn is not None else []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            entries = (kw.value.elts
+                       if isinstance(kw.value, (ast.Tuple, ast.List))
+                       else [kw.value])
+            for e in entries:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                        and not isinstance(e.value, bool):
+                    if 0 <= e.value < len(plist):
+                        names.append(plist[e.value])
+                elif isinstance(e, ast.Constant):
+                    bad.append(repr(e.value))
+        elif kw.arg == "static_argnames":
+            entries = (kw.value.elts
+                       if isinstance(kw.value, (ast.Tuple, ast.List))
+                       else [kw.value])
+            for e in entries:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.append(e.value)
+                elif isinstance(e, ast.Constant):
+                    bad.append(repr(e.value))
+    return names, bad
+
+
+def _used_as_array(fn, pname):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == pname and node.attr in _ARRAY_ATTRS:
+            return f"`.{node.attr}` access"
+        if isinstance(node, ast.Call):
+            cname = dotted_name(node.func) or ""
+            if cname.startswith(_JNP_PREFIXES):
+                for a in node.args:
+                    if isinstance(a, ast.Name) and a.id == pname:
+                        return f"passed to `{cname}`"
+    return None
+
+
+def _mutable_default(fn, pname):
+    a = fn.args
+    pos = a.posonlyargs + a.args
+    defaults = a.defaults
+    for p, d in zip(pos[len(pos) - len(defaults):], defaults):
+        if p.arg == pname and isinstance(d, (ast.List, ast.Dict, ast.Set)):
+            return True
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if p.arg == pname and isinstance(d, (ast.List, ast.Dict, ast.Set)):
+            return True
+    return False
+
+
+class StaticArgsRule:
+    rule_id = RULE_ID
+    summary = ("static_argnums pointing at an array-valued or unhashable "
+               "parameter (per-value recompile churn or TypeError)")
+
+    def check(self, ctx):
+        for call, fn in _jit_sites(ctx.tree):
+            names, bad = _static_param_names(call, fn)
+            for b in bad:
+                yield ctx.finding(
+                    self.rule_id, call,
+                    f"malformed static_argnums/static_argnames entry {b} — "
+                    f"must be an int index or parameter name")
+            if fn is None:
+                continue
+            for pname in names:
+                use = _used_as_array(fn, pname)
+                if use:
+                    yield ctx.finding(
+                        self.rule_id, call,
+                        f"static arg `{pname}` of `{fn.name}` is used as an "
+                        f"array ({use}) — static args are hashed per call; "
+                        f"an array here raises or recompiles per value")
+                elif _mutable_default(fn, pname):
+                    yield ctx.finding(
+                        self.rule_id, call,
+                        f"static arg `{pname}` of `{fn.name}` has a mutable "
+                        f"(unhashable) default — jit will TypeError when it "
+                        f"is used")

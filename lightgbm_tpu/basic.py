@@ -378,6 +378,7 @@ class Booster:
             TIMERS.enabled = True
         self._gbdt = None
         self.trees: List[Tree] = []          # flattened tree list (iter-major)
+        self._forest_rev = 0                 # bumped whenever trees change
         self.num_model_per_iteration = 1
         self.best_iteration = 0
         self.best_score: Dict = {}
@@ -522,21 +523,28 @@ class Booster:
         return self
 
     def _ensure_finalized(self):
-        """Materialize host trees iff device state has newer iterations
-        (shared by get_leaf_output, the C API's lazy sync, and eval-time
-        replay; one home for the K/prev-trees accounting)."""
+        """Materialize host trees iff device state changed since the last
+        sync (shared by get_leaf_output, the C API's lazy sync, predict, and
+        eval-time replay; one home for the K/prev-trees accounting). The
+        mutation counter — not just the length — decides: rollback (explicit
+        or the no-splits pop) followed by a retrain lands back on the same
+        length with different trees."""
         if self._gbdt is None:
             return
         K = max(self.num_model_per_iteration, 1)
         expected = (len(getattr(self, "_prev_trees", []))
                     + self._gbdt.iter_ * K)
-        if len(self.trees) != expected:
+        synced = getattr(self, "_synced_mutations", -1)
+        if len(self.trees) != expected or \
+                getattr(self._gbdt, "mutations_", 0) != synced:
             self._finalize()
 
     def _finalize(self):
         forest = self._gbdt.finalize_model()
         self.trees = getattr(self, "_prev_trees", []) + \
             [t for it_trees in forest for t in it_trees]
+        self._forest_rev = getattr(self, "_forest_rev", 0) + 1
+        self._synced_mutations = getattr(self._gbdt, "mutations_", 0)
         self.init_score_value = self._gbdt.init_score_value
         self.best_iteration = getattr(self._gbdt, "best_iteration", 0)
 
@@ -551,6 +559,7 @@ class Booster:
     def predict(self, data, num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        self._ensure_finalized()
         if hasattr(data, "values") and hasattr(data, "columns"):
             data, _, _, _ = _data_from_pandas(data, self.pandas_categorical)
         if _is_sparse(data):
@@ -654,19 +663,28 @@ class Booster:
 
     def _stacked_forests(self, use_trees, K: int):
         """Per-class StackedForests for device batch predict, cached across
-        calls (rebuilt when the forest grows). Returns None when any class
-        slice holds a categorical split — the host path handles those."""
+        calls in a small LRU keyed by the tree slice — serving loops that
+        alternate num_iteration (full model vs early-stopped prefix) keep
+        both entries warm instead of rebuilding every call. Returns None
+        when any class slice holds a categorical split — the host path
+        handles those."""
         from .ops.predict import StackedForest
-        key = (len(self.trees), len(use_trees), K)
-        cached = getattr(self, "_stacked_cache", None)
-        if cached is not None and cached[0] == key:
-            return cached[1]
+        from .utils.cache import LRUCache
+        # _forest_rev (not len(trees)) keys the content: rollback + retrain
+        # lands back on the same length with different trees
+        key = (getattr(self, "_forest_rev", 0), len(use_trees), K)
+        cache = getattr(self, "_stacked_cache", None)
+        if cache is None:
+            cache = self._stacked_cache = LRUCache(capacity=4)
+        forests = cache.get(key, default=False)
+        if forests is not False:
+            return forests
         if any((np.asarray(t.decision_type) & 1).any() for t in use_trees):
             forests = None                   # cheap pre-scan: host path
         else:
             forests = [StackedForest(use_trees[k::K], self.num_total_features)
                        for k in range(K)]
-        self._stacked_cache = (key, forests)
+        cache.put(key, forests)
         return forests
 
     def _convert_output(self, raw: np.ndarray) -> np.ndarray:

@@ -399,6 +399,10 @@ class GBDT:
         self.models: List[List] = []        # per iteration: list of K device TreeArrays
         self._num_leaves_dev: List = []     # per iteration: [K] device array
         self.iter_ = 0
+        # monotonic forest-content counter: iter_ alone can collide after a
+        # rollback (explicit or the no-splits pop) followed by a retrain,
+        # which would let stale materialized host trees pass a length check
+        self.mutations_ = 0
         # device-resident twins of the per-step host scalars: through a
         # remote-device tunnel every host->device scalar costs a round
         # trip (~120 ms/tree of the round-3..5 bench gap between
@@ -669,6 +673,7 @@ class GBDT:
         self.models.append(list(trees))
         self._num_leaves_dev.append(nl)
         self.iter_ += 1
+        self.mutations_ = getattr(self, "mutations_", 0) + 1
         return score, out_valid
 
     def train_one_iter(self) -> None:
@@ -728,6 +733,7 @@ class GBDT:
         trees = self.models.pop()
         self._num_leaves_dev.pop()
         self.iter_ -= 1
+        self.mutations_ = getattr(self, "mutations_", 0) + 1
         self._iter_dev = None           # device counter resyncs next step
         score = self.score
         new_scores = []
@@ -800,6 +806,7 @@ class GBDT:
             self.models.pop()
             self._num_leaves_dev.pop()
             self.iter_ -= 1
+            self.mutations_ = getattr(self, "mutations_", 0) + 1
             self._iter_dev = None       # device counter resyncs next step
             return True
         return False

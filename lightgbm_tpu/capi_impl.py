@@ -523,6 +523,7 @@ def booster_merge(handle: int, other_handle: int) -> None:
         if bst._gbdt is not None:
             bst.free_dataset()
         bst.trees = list(bst.trees) + [copy.deepcopy(t) for t in other.trees]
+        bst._forest_rev = getattr(bst, "_forest_rev", 0) + 1
         bst._stacked_cache = None
 
 

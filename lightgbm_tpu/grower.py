@@ -542,7 +542,8 @@ def grow_tree(
         miss_bin = jnp.where(mc_s == 2, nb_s - 1,
                              jnp.where(mc_s == 1, db_s, -1))
         table = jnp.zeros((L + 1, 6), jnp.int32).at[:, 0].set(-1).at[:, 2].set(-1)
-        rows = jnp.stack([sf, cand.threshold[p], miss_bin, q,
+        rows = jnp.stack([sf.astype(jnp.int32), cand.threshold[p],
+                          miss_bin.astype(jnp.int32), q.astype(jnp.int32),
                           cand.default_left[p].astype(jnp.int32),
                           cand.is_cat[p].astype(jnp.int32)], axis=-1)
         table = table.at[p].set(rows, mode="drop").at[L].set(

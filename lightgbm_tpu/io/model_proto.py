@@ -93,6 +93,7 @@ def load_model_proto(booster, filename: str) -> None:
     with open(filename, "rb") as fh:
         m = model_pb2.Model.FromString(fh.read())
     booster.trees = [_tree_from_proto(t) for t in m.trees]
+    booster._forest_rev = getattr(booster, "_forest_rev", 0) + 1
     booster.num_model_per_iteration = m.num_tree_per_iteration or 1
     booster.num_total_features = m.max_feature_idx + 1
     booster.feature_names = list(m.feature_names)

@@ -225,6 +225,7 @@ def load_model_string(booster, model_str: str) -> None:
         i += 1
 
     booster.trees = trees
+    booster._forest_rev = getattr(booster, "_forest_rev", 0) + 1
     booster.num_model_per_iteration = int(header.get("num_tree_per_iteration", "1"))
     booster.num_total_features = int(header.get("max_feature_idx", "-1")) + 1
     booster.feature_names = header.get("feature_names", "").split()

@@ -48,7 +48,12 @@ def weight_channels(grad, hess, included, hilo):
     if hilo is True:
         g_hi, g_lo = _split_hi_lo(grad)
         h_hi, h_lo = _split_hi_lo(hess)
-        return jnp.stack([g_hi, g_lo, h_hi, h_lo,
+        # every input cast explicitly (R003): a dtype change upstream in
+        # _split_hi_lo must not silently widen the packed channel matrix
+        return jnp.stack([g_hi.astype(jnp.bfloat16),
+                          g_lo.astype(jnp.bfloat16),
+                          h_hi.astype(jnp.bfloat16),
+                          h_lo.astype(jnp.bfloat16),
                           included.astype(jnp.bfloat16)], axis=-1)
     if hilo == "f32":
         return jnp.stack([grad.astype(jnp.float32),

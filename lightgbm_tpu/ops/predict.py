@@ -39,7 +39,9 @@ def leaves_from_binned(
     mc, nb, db = missing_code[sf], num_bins[sf], default_bin[sf]
     miss_bin = jnp.where(mc == 2, nb - 1, jnp.where(mc == 1, db, -1))
     node_tab = jnp.stack(
-        [sf, tree.threshold_bin, miss_bin, tree.left_child, tree.right_child,
+        [sf.astype(jnp.int32), tree.threshold_bin.astype(jnp.int32),
+         miss_bin.astype(jnp.int32), tree.left_child.astype(jnp.int32),
+         tree.right_child.astype(jnp.int32),
          tree.default_left.astype(jnp.int32), tree.is_cat.astype(jnp.int32)],
         axis=-1)                                                 # [M+1, 7]
     iota_f = jnp.arange(Xb.shape[1], dtype=jnp.int32)[None, :]
@@ -250,7 +252,8 @@ def forest_predict_raw(trees, X: np.ndarray, num_features: int,
     for lo in range(0, X.shape[0], chunk_rows):
         chunk = np.asarray(X[lo:lo + chunk_rows], np.float64)
         codes, is_nan, is_zero = forest.encode_rows(chunk)
-        out[lo:lo + chunk_rows] = np.asarray(_forest_walk(
+        # host boundary: predict RETURNS numpy — the sync is the contract
+        out[lo:lo + chunk_rows] = np.asarray(_forest_walk(  # tpu-lint: disable=R002
             *dev, jnp.asarray(codes), jnp.asarray(is_nan),
             jnp.asarray(is_zero)))
     return out

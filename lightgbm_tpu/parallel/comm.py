@@ -39,6 +39,30 @@ from ..ops.split_finder import (PerFeatureBest, SplitCandidates,
                                 per_feature_best_numerical, reduce_features)
 
 
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """jax.shard_map with replication checking off, across jax versions.
+
+    The kwarg that disables the check was renamed check_rep -> check_vma,
+    and the function itself moved from jax.experimental.shard_map to jax
+    top-level — on different releases, in different combinations (0.5-0.6
+    export jax.shard_map that still takes check_rep). Feature-detect the
+    kwarg on whichever function exists instead of keying off the module."""
+    import inspect
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        params = inspect.signature(sm).parameters
+    except (TypeError, ValueError):
+        params = {}
+    if "check_vma" in params:
+        kwargs["check_vma"] = False
+    elif "check_rep" in params:
+        kwargs["check_rep"] = False
+    return sm(fn, **kwargs)
+
+
 class BlockMeta(NamedTuple):
     """Per-feature metadata of the feature block this device scans.
 
@@ -431,8 +455,8 @@ class ParallelContext:
         rows2d = P(self.ROW_AXIS, None) if self.strategy in ("data", "voting") else P()
         in_specs = (rows2d, rows, rows, rows, P(), P(), P(), P(), P())
         out_specs = (P(), rows)       # (TreeArrays..., leaf_id)
-        return jax.shard_map(grow_fn, mesh=self.mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
+        return _shard_map(grow_fn, mesh=self.mesh, in_specs=in_specs,
+                          out_specs=out_specs)
 
 
 def parse_machine_list(config) -> list:

@@ -33,3 +33,18 @@ except Exception:
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running test (multi-process cluster, big data)")
+    config.addinivalue_line(
+        "markers", "tpu: requires a real TPU backend (Mosaic lowering, "
+                   "device transfer semantics); skipped under the hermetic "
+                   "CPU harness / JAX_PLATFORMS=cpu")
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+    if jax.default_backend() == "tpu":
+        return
+    skip_tpu = pytest.mark.skip(
+        reason="requires real TPU hardware (hermetic CPU harness)")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip_tpu)

@@ -1,0 +1,10 @@
+"""Fixture: violates exactly R001 — Python `if` on a traced value."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def leaky_relu(x):
+    if x.sum() > 0:          # R001: concretizes a tracer
+        return x
+    return jnp.zeros_like(x)

@@ -1,0 +1,9 @@
+"""Fixture: violates exactly R003 — mixed-cast jnp.stack inputs."""
+import jax.numpy as jnp
+
+
+def pack_channels(grad, hess, included):
+    g = grad.astype(jnp.bfloat16)
+    h = hess
+    return jnp.stack([g.astype(jnp.bfloat16), h,
+                      included.astype(jnp.bfloat16)], axis=-1)  # R003: h bare

@@ -1,0 +1,7 @@
+"""Fixture: violates exactly R004 — the round-5 bug class: a 125-row
+accumulator block (S=25 slots x ch=5 channels) is not sublane-aligned."""
+from jax.experimental import pallas as pl
+
+
+def make_spec():
+    return pl.BlockSpec((125, 7168), lambda i, n: (0, 0))   # R004: 125 % 8

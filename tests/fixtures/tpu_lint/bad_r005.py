@@ -1,0 +1,10 @@
+"""Fixture: violates exactly R005 — array-valued static_argnums."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(1,))
+def lookup(x, table):                 # R005: `table` is hashed per call
+    return x + jnp.sum(table)

@@ -1,0 +1,7 @@
+"""Fixture: violates exactly R006 — jnp execution at import time."""
+import jax.numpy as jnp
+
+BIN_IOTA = jnp.arange(256)            # R006: backend init on import
+
+if __name__ == "__main__":
+    print(jnp.sum(BIN_IOTA))          # exempt: script time, not import

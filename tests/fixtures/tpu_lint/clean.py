@@ -1,0 +1,30 @@
+"""Fixture: violates nothing — the hygienic versions of every bad_* file."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+@jax.jit
+def leaky_relu(x):
+    return jnp.where(x.sum() > 0, x, jnp.zeros_like(x))
+
+
+def pack_channels(grad, hess, included):
+    return jnp.stack([grad.astype(jnp.bfloat16), hess.astype(jnp.bfloat16),
+                      included.astype(jnp.bfloat16)], axis=-1)
+
+
+def make_spec():
+    return pl.BlockSpec((128, 7168), lambda i, n: (0, 0))
+
+
+@partial(jax.jit, static_argnums=(1,))
+def chunked(x, chunk_rows):
+    return x.reshape(-1, chunk_rows).sum(axis=1)
+
+
+def suppressed(total):
+    s = jnp.sum(total)
+    return float(s)  # tpu-lint: disable=R002

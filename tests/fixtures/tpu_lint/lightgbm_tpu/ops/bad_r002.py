@@ -1,0 +1,15 @@
+"""Fixture: violates exactly R002 — host sync in a hot-path module.
+
+The lint scopes R002 by path; the test passes this file's rel path as
+``lightgbm_tpu/ops/bad_r002.py`` so it lands in the hot set.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+
+def wave_loop(codes):
+    total = jnp.sum(codes)
+    for _ in range(10):
+        host_total = float(total)      # R002: d2h sync every iteration
+        np.asarray(total)              # R002: and again
+    return host_total

@@ -1,0 +1,100 @@
+"""LRUCache (utils/cache.py) unit tests: eviction order, capacity-0 edge,
+hit/miss counters — plus its live wiring in Booster._stacked_forests."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.utils.cache import LRUCache
+
+
+def test_basic_put_get_and_counters():
+    c = LRUCache(capacity=2)
+    assert c.get("a") is None
+    assert c.stats() == {"size": 0, "capacity": 2, "hits": 0, "misses": 1}
+    c.put("a", 1)
+    assert c.get("a") == 1
+    assert c.hits == 1 and c.misses == 1
+    assert len(c) == 1 and "a" in c
+
+
+def test_eviction_is_least_recently_used():
+    c = LRUCache(capacity=2)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1          # refresh a: b is now LRU
+    c.put("c", 3)                   # evicts b
+    assert "b" not in c
+    assert c.get("b") is None
+    assert c.get("a") == 1 and c.get("c") == 3
+    assert c.keys() == ["a", "c"]   # eviction order: LRU first
+
+
+def test_put_refreshes_recency_and_overwrites():
+    c = LRUCache(capacity=2)
+    c.put("a", 1)
+    c.put("b", 2)
+    c.put("a", 10)                  # overwrite refreshes a
+    c.put("c", 3)                   # evicts b, not a
+    assert c.get("a") == 10 and "b" not in c
+
+
+def test_capacity_zero_disables_storage():
+    c = LRUCache(capacity=0)
+    c.put("a", 1)
+    assert len(c) == 0
+    assert c.get("a", default="fallback") == "fallback"
+    assert c.misses == 1 and c.hits == 0
+
+
+def test_negative_capacity_rejected():
+    with pytest.raises(ValueError):
+        LRUCache(capacity=-1)
+
+
+def test_none_is_a_cacheable_value():
+    """None values (the 'categorical -> host path' sentinel in
+    _stacked_forests) must be distinguishable from a miss via default."""
+    c = LRUCache(capacity=2)
+    c.put("k", None)
+    assert c.get("k", default="MISS") is None
+    assert c.hits == 1
+
+
+def test_clear_resets_entries_not_counters():
+    c = LRUCache(capacity=2)
+    c.put("a", 1)
+    c.get("a")
+    c.clear()
+    assert len(c) == 0 and c.hits == 1
+
+
+def test_stacked_forest_cache_alternating_slices():
+    """Serving-loop shape: predict with full model, then a prefix, then
+    full again — the second full-model call must be an LRU hit, not a
+    rebuild (the old single-entry cache thrashed here)."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    X = rng.rand(400, 5).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    params = dict(objective="binary", num_leaves=7, max_bin=31,
+                  min_data_in_leaf=5, verbose=-1, metric="none")
+    bst = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y,
+                                                           params=params))
+    for _ in range(4):
+        bst.update()
+    f_full = bst._stacked_forests(bst.trees, 1)
+    rev0 = bst._forest_rev
+    f_pre = bst._stacked_forests(bst.trees[:2], 1)
+    cache = bst._stacked_cache
+    hits0 = cache.hits
+    again = bst._stacked_forests(bst.trees, 1)
+    assert again is f_full
+    assert cache.hits == hits0 + 1
+    assert bst._stacked_forests(bst.trees[:2], 1) is f_pre
+    # rollback + retrain lands on the same forest LENGTH with different
+    # trees — the rev-based key must not serve the pre-rollback forest
+    bst.rollback_one_iter()
+    bst.update()
+    preds = bst.predict(X)              # forces the lazy host-tree resync
+    assert preds.shape == (400,)
+    assert bst._forest_rev > rev0
+    assert bst._stacked_forests(bst.trees, 1) is not f_full

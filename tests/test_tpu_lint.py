@@ -1,0 +1,135 @@
+"""tpu-lint self-tests: every rule fires on its deliberately-broken fixture
+(and ONLY its rule), the clean fixture stays clean, suppressions work at
+all three levels (inline pragma, file pragma, baseline), and the live
+package lints clean against the committed baseline — the same invocation
+`make lint` / the tier-1 verify line runs in CI."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from lightgbm_tpu.analysis.tpu_lint import (Baseline, Finding, lint_file,
+                                            lint_paths, main)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXDIR = os.path.join(HERE, "fixtures", "tpu_lint")
+
+# (fixture path relative to FIXDIR, rule id it must violate)
+BAD_FIXTURES = [
+    ("bad_r001.py", "R001"),
+    (os.path.join("lightgbm_tpu", "ops", "bad_r002.py"), "R002"),
+    ("bad_r003.py", "R003"),
+    ("bad_r004.py", "R004"),
+    ("bad_r005.py", "R005"),
+    ("bad_r006.py", "R006"),
+]
+
+
+@pytest.mark.parametrize("relpath,rule", BAD_FIXTURES)
+def test_bad_fixture_violates_exactly_its_rule(relpath, rule):
+    findings, err = lint_file(os.path.join(FIXDIR, relpath))
+    assert err is None
+    assert findings, f"{relpath}: expected {rule} finding(s), got none"
+    assert {f.rule for f in findings} == {rule}, \
+        f"{relpath}: expected only {rule}, got {[f.format() for f in findings]}"
+
+
+def test_clean_fixture_has_no_findings():
+    findings, err = lint_file(os.path.join(FIXDIR, "clean.py"))
+    assert err is None
+    assert findings == [], [f.format() for f in findings]
+
+
+@pytest.mark.parametrize("relpath,rule", BAD_FIXTURES)
+def test_cli_exits_nonzero_on_each_fixture(relpath, rule):
+    out = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.analysis",
+         os.path.join(FIXDIR, relpath), "--no-baseline"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert rule in out.stdout
+
+
+def test_live_package_clean_against_committed_baseline():
+    out = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.analysis", "lightgbm_tpu/"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_inline_pragma_suppresses(tmp_path):
+    src = textwrap.dedent("""\
+        import jax.numpy as jnp
+        BINS = jnp.arange(4)  # tpu-lint: disable=R006
+    """)
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings, _ = lint_file(str(p))
+    assert findings == []
+    # without the pragma the same line fires
+    p.write_text(src.replace("  # tpu-lint: disable=R006", ""))
+    findings, _ = lint_file(str(p))
+    assert [f.rule for f in findings] == ["R006"]
+
+
+def test_file_pragma_suppresses(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("# tpu-lint: disable-file=R006\n"
+                 "import jax.numpy as jnp\n"
+                 "A = jnp.arange(4)\n"
+                 "B = jnp.zeros(8)\n")
+    findings, _ = lint_file(str(p))
+    assert findings == []
+
+
+def test_baseline_roundtrip_and_consumption(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("import jax.numpy as jnp\nA = jnp.arange(4)\n")
+    findings, _ = lint_file(str(p))
+    assert len(findings) == 1
+    bl = Baseline.from_findings(findings)
+    bl_path = tmp_path / "baseline.json"
+    bl.dump(str(bl_path))
+
+    loaded = Baseline.load(str(bl_path))
+    assert loaded.suppresses(findings[0])
+    # each baseline entry suppresses exactly its count — a SECOND identical
+    # finding (a regression on another line) still fails
+    dup = Finding(**{**findings[0].__dict__, "line": 99})
+    assert not loaded.suppresses(dup)
+
+
+def test_baseline_fingerprint_survives_line_moves(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("import jax.numpy as jnp\nA = jnp.arange(4)\n")
+    findings, _ = lint_file(str(p))
+    bl = Baseline.from_findings(findings)
+    # unrelated edit above shifts the line; fingerprint (file, rule,
+    # snippet) still matches
+    p.write_text("import jax.numpy as jnp\n\n\nA = jnp.arange(4)\n")
+    moved, _ = lint_file(str(p))
+    assert len(moved) == 1 and moved[0].line != findings[0].line
+    assert bl.suppresses(moved[0])
+
+
+def test_main_select_and_json_format(capsys):
+    rc = main([os.path.join(FIXDIR, "bad_r001.py"), "--no-baseline",
+               "--select", "R004", "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0 and data["findings"] == []   # R001 file, R004-only scan
+    rc = main([os.path.join(FIXDIR, "bad_r001.py"), "--no-baseline",
+               "--select", "R001", "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1 and [f["rule"] for f in data["findings"]] == ["R001"]
+
+
+def test_syntax_error_reported_not_crash(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    findings, errors = lint_paths([str(p)])
+    assert findings == []
+    assert len(errors) == 1 and "cannot parse" in errors[0]
