@@ -15,10 +15,17 @@ PYTEST := python -m pytest -q
 lint:
 	python -m lightgbm_tpu.analysis lightgbm_tpu/
 
-# CI gate: lint + tier-1 tests + the recompile guard on a 5-iter smoke run.
+# CI gate: lint + tier-1 tests + the recompile guard on a 5-iter smoke run
+# (which now also asserts a checkpoint save/resume cycle stays recompile-free).
 verify: lint
 	env JAX_PLATFORMS=cpu $(PYTEST) tests/ -m 'not slow'
 	python bench.py --smoke
+
+# Fault-injection suite (docs/Fault-Tolerance.md): KV delay/drop/corruption
+# through the chaos harness + all three nan_policy branches + kill-and-resume.
+# The pinned seed makes a failing run replayable bit-for-bit.
+chaos:
+	env JAX_PLATFORMS=cpu LGBM_TPU_CHAOS_SEED=1234 $(PYTEST) tests/ -m chaos
 
 check-fast:
 	$(PYTEST) tests/test_parallel.py tests/test_wave_parity.py \
@@ -35,4 +42,4 @@ capi:
 bench-cpu:
 	LGBM_TPU_BENCH_ROWS=400000 JAX_PLATFORMS=cpu python bench.py
 
-.PHONY: lint verify check-fast check capi bench-cpu
+.PHONY: lint verify check-fast check capi bench-cpu chaos

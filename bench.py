@@ -843,7 +843,11 @@ def run_smoke():
     steady-state train step recompiles after warm-up. The CI-enforced form
     of the round-5 per-shape gate: shape/static leaks into the step
     signature show up here as a nonzero miss count, before any TPU sees
-    them. Prints one JSON line; exit 0 iff the guard holds."""
+    them. Also asserts a checkpoint save/resume round trip
+    (docs/Fault-Tolerance.md) stays recompile-free: a mid-loop
+    save_checkpoint and a full resume into a fresh booster must both keep
+    hitting the warm executable. Prints one JSON line; exit 0 iff the
+    guards hold."""
     from lightgbm_tpu.utils.hermetic import force_cpu_backend
     force_cpu_backend()
     import lightgbm_tpu as lgb
@@ -873,13 +877,50 @@ def run_smoke():
     except GuardViolation as e:
         ok, err = False, str(e)
     report = guard.report()
+
+    # ---- checkpoint save/resume round trip under the guard -----------------
+    import shutil
+    import tempfile
+    ck_dir = tempfile.mkdtemp(prefix="lgbm_smoke_ckpt_")
+    resume_ok, resume_err, resume_misses = True, None, -1
+    try:
+        bst.save_checkpoint(ck_dir)
+        ds2 = lgb.Dataset(X, label=y, params=params)
+        bst2 = lgb.Booster(params=params, train_set=ds2)
+        bst2.resume(ck_dir)
+        for _ in range(2):            # same warm-up budget as a fresh run:
+            bst2.update()             # first-step compile + the committed-
+        np.asarray(bst2._gbdt.score).sum()   # sharding steady-state variant
+        guard2 = RecompileGuard(label="smoke-resume")
+        guard2.register(bst2._gbdt._step_fn, "train_step")
+        try:
+            with guard2:
+                guard2.mark_warm()
+                for i in range(iters):
+                    bst2.update()
+                    if i == iters // 2:
+                        # an in-loop snapshot must not perturb the step
+                        bst2.save_checkpoint(ck_dir)
+                np.asarray(bst2._gbdt.score).sum()
+        except GuardViolation as e:
+            resume_ok, resume_err = False, str(e)
+        resume_misses = guard2.report()["post_warmup_cache_misses"]
+    except Exception as e:            # noqa: BLE001 — any failure fails CI
+        resume_ok, resume_err = False, f"{type(e).__name__}: {e}"
+    finally:
+        shutil.rmtree(ck_dir, ignore_errors=True)
+
     out = {"metric": "smoke_recompile_guard", "rows": n_rows, "iters": iters,
            "post_warmup_cache_misses": report["post_warmup_cache_misses"],
-           "host_syncs": report["host_syncs"], "ok": ok}
+           "host_syncs": report["host_syncs"],
+           "resume_post_warmup_cache_misses": resume_misses,
+           "ok": ok and resume_ok}
     if err:
         out["error"] = err[:300]
+    if resume_err:
+        out["resume_error"] = resume_err[:300]
     print(json.dumps(out))
-    return 0 if ok else 1
+    return 0 if (ok and resume_ok) else 1
 
 
 if __name__ == "__main__":

@@ -387,6 +387,7 @@ class Booster:
         self.mappers = []
         self.init_score_value = 0.0
         self.pandas_categorical = None
+        self.eval_history: Dict = {}         # dataset -> metric -> [values]
         self._attr: Dict[str, str] = {}
         self._train_data_name = "training"
         self._valid_registry: List = []      # (Dataset, name) identity pairs
@@ -547,6 +548,100 @@ class Booster:
         self._synced_mutations = getattr(self._gbdt, "mutations_", 0)
         self.init_score_value = self._gbdt.init_score_value
         self.best_iteration = getattr(self._gbdt, "best_iteration", 0)
+
+    # -- checkpoint/resume (robustness/checkpoint.py; docs/Fault-Tolerance.md)
+
+    def save_checkpoint(self, directory: Optional[str] = None) -> Optional[str]:
+        """Write one atomic snapshot of the full training state — finalized
+        forest, raw scores, bagging RNG key, iteration counter, eval history,
+        config fingerprint — to ``directory`` (default: config
+        ``checkpoint_dir``). Resumable via :meth:`resume` or
+        ``engine.train(resume_from=...)``. Under multi-host execution every
+        process participates in the (collective) state fetch but only
+        process 0 writes; returns the written path, or None on non-writing
+        ranks."""
+        from .robustness.checkpoint import (CheckpointManager,
+                                            config_fingerprint,
+                                            fingerprinted_config)
+        if self._gbdt is None:
+            Log.fatal("save_checkpoint needs live training state — the "
+                      "booster was freed or loaded from a model file")
+        if self.config.boosting_normalized == "dart":
+            Log.fatal("checkpoint/resume does not support boosting=dart "
+                      "(host-side drop state is not captured)")
+        directory = directory or self.config.checkpoint_dir
+        mgr = CheckpointManager(directory,
+                                keep_last_n=self.config.checkpoint_keep_last_n)
+        self._ensure_finalized()
+        state = self._gbdt.checkpoint_state()
+        payload = {
+            "config_fingerprint": config_fingerprint(self.config),
+            "config": fingerprinted_config(self.config),
+            "iteration": state["iter"],
+            "state": state,
+            "eval_history": self.eval_history,
+            "booster": {
+                "trees": self.trees,
+                "prev_trees": list(getattr(self, "_prev_trees", [])),
+                "best_iteration": self.best_iteration,
+                "best_score": self.best_score,
+                "feature_names": self.feature_names,
+            },
+        }
+        import jax
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return None
+        path = mgr.save(payload)
+        Log.info("checkpoint written: %s (iteration %d, %d trees)", path,
+                 state["iter"], len(self.trees))
+        return path
+
+    def resume(self, path_or_dir: Optional[str] = None) -> "Booster":
+        """Replay a checkpoint into this booster's live training state.
+
+        ``path_or_dir`` is a snapshot file or a checkpoint directory (whose
+        latest snapshot is used); default is config ``checkpoint_dir``. The
+        booster must already be constructed against the SAME dataset and
+        training config — a config-fingerprint mismatch fails loudly naming
+        the differing fields. Continued training after resume is
+        bit-identical to a run that was never interrupted."""
+        from .robustness.checkpoint import (CheckpointError,
+                                            CheckpointManager,
+                                            config_fingerprint,
+                                            config_mismatch_fields)
+        if self._gbdt is None:
+            Log.fatal("resume needs a constructed training setup — build "
+                      "the Booster with the same train_set/params first")
+        if self.config.boosting_normalized == "dart":
+            Log.fatal("checkpoint/resume does not support boosting=dart "
+                      "(host-side drop state is not captured)")
+        target = path_or_dir or self.config.checkpoint_dir
+        if not target:
+            Log.fatal("resume: no checkpoint path given and checkpoint_dir "
+                      "is empty")
+        payload = CheckpointManager.load(target)
+        if payload["config_fingerprint"] != config_fingerprint(self.config):
+            fields = config_mismatch_fields(payload["config"], self.config)
+            raise CheckpointError(
+                f"config fingerprint mismatch resuming from {target}: the "
+                f"snapshot was written under a config whose training "
+                f"semantics differ in: {', '.join(fields) or '<unknown>'}. "
+                f"Resume requires an identical training config (run-control "
+                f"fields like num_iterations and paths are exempt).")
+        self._gbdt.restore_checkpoint_state(payload["state"])
+        b = payload.get("booster", {})
+        self.trees = list(b.get("trees", []))
+        self._prev_trees = list(b.get("prev_trees", []))
+        self._forest_rev = getattr(self, "_forest_rev", 0) + 1
+        self._synced_mutations = getattr(self._gbdt, "mutations_", 0)
+        self.best_iteration = int(b.get("best_iteration", 0))
+        self.best_score = b.get("best_score", {}) or {}
+        self.eval_history = payload.get("eval_history", {}) or {}
+        self.init_score_value = self._gbdt.init_score_value
+        Log.info("resumed from checkpoint (id %s) at iteration %d "
+                 "(%d trees)", payload.get("checkpoint_id", "?"),
+                 self._gbdt.iter_, len(self.trees))
+        return self
 
     # -- prediction ----------------------------------------------------------
 

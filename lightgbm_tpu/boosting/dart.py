@@ -27,6 +27,13 @@ class DART(GBDT):
     def __init__(self, config: Config, train_set, objective=None):
         super().__init__(config, train_set, objective)
         Log.info("Using DART")
+        if config.nan_policy in ("raise", "skip_iter"):
+            # the gated no-op step composes with DART's host-side drop/
+            # renormalize arithmetic incorrectly (the post-step correction
+            # would re-add dropped contributions a skipped step never took
+            # out) — only the in-step policies are sound here
+            Log.fatal("nan_policy=%s is not supported with boosting=dart "
+                      "(use none or clip)", config.nan_policy)
         self.tree_weight: List[float] = []
         self.sum_weight = 0.0
         self._drop_rng = np.random.default_rng(config.drop_seed)

@@ -35,6 +35,7 @@ from ..grower import GrowerSpec, TreeArrays, grow_tree
 from ..ops.histogram import table_lookup
 from ..parallel.comm import make_parallel_context
 from ..metrics import Metric, create_metrics
+from ..robustness import allowed_host_sync
 from ..utils.timer import TIMERS
 from ..objectives import Objective, create_objective
 from ..ops.predict import leaves_from_binned
@@ -289,6 +290,19 @@ class GBDT:
         else:
             Xb = train_set.X_binned
             self._hist_bins = 0
+        # dataset fingerprint for checkpoint/resume: the config fingerprint
+        # deliberately excludes data PATHS, so a resumed run pointed at a
+        # different dataset of the same shape must be caught here — a strided
+        # sample of the binned codes plus the full label vector, hashed while
+        # both are still host arrays (no device fetch, computed once)
+        import hashlib
+        _fp = hashlib.sha256()
+        _fp.update(np.int64([N, Xb.shape[0], Xb.shape[1]]).tobytes())
+        _stride = max(1, Xb.shape[0] // 256)
+        _fp.update(np.ascontiguousarray(Xb[::_stride]).tobytes())
+        _fp.update(np.asarray(meta_global.label, np.float32).tobytes())
+        self._data_fingerprint = _fp.hexdigest()
+
         # device placement of the (possibly bundled) code matrix: rows padded
         # to Npad (equal per-process blocks under pre-partition, where only
         # the LOCAL shard exists on this host), columns to the strategy pad
@@ -419,6 +433,11 @@ class GBDT:
         self.bagging_on = config.bagging_freq > 0 and config.bagging_fraction < 1.0
         self.bag_mask = self.pad_mask
         self.best_iteration = 0
+
+        # non-finite guard (robustness/numeric.py): a trace-time constant —
+        # "none" compiles the exact unguarded step program
+        self.nan_policy = config.nan_policy
+        self._consecutive_skips = 0
 
         self._step_fn = None
         self._custom_step_fn = None
@@ -587,6 +606,10 @@ class GBDT:
                 for vs, xb in zip(self.valid_sets, saved_vXb):
                     vs.Xb = xb
 
+        nan_policy = self.nan_policy
+        if nan_policy != "none":
+            from ..robustness.numeric import clip_nonfinite, nonfinite_flag
+
         def step_body(score, valid_scores, bag_mask, key, it, shrinkage, *grads):
             # key arrives RAW; folding by the device iteration counter here
             # reproduces the former host-side fold_in(rng, iter_) stream
@@ -597,6 +620,13 @@ class GBDT:
                 g, h = grads
             else:
                 g, h = self._gradients(score)
+            bad_g = bad_h = bad_leaf = None
+            if nan_policy != "none":
+                # detect BEFORE any sanitizing so every policy can report
+                # which of g/h/leaf went non-finite
+                bad_g, bad_h = nonfinite_flag(g), nonfinite_flag(h)
+                if nan_policy == "clip":
+                    g, h = clip_nonfinite(g), clip_nonfinite(h)
             bkey, fkey = jax.random.split(jax.random.fold_in(key, 0))
             mask, g, h = self._sampling(g, h, bag_mask, bkey, it)
             trees = []
@@ -623,6 +653,13 @@ class GBDT:
                     leaf_value=tree.leaf_value * shrinkage,
                     internal_value=tree.internal_value * shrinkage)
                 tree = self._tree_output_transform(tree)
+                if nan_policy != "none":
+                    bl = nonfinite_flag(tree.leaf_value)
+                    bad_leaf = bl if bad_leaf is None else (bad_leaf | bl)
+                    if nan_policy == "clip":
+                        tree = tree._replace(
+                            leaf_value=clip_nonfinite(tree.leaf_value),
+                            internal_value=clip_nonfinite(tree.internal_value))
                 new_scores.append(self._score_update(
                     score[k], table_lookup(leaf_ids, tree.leaf_value), it))
                 for vi, vs in enumerate(self.valid_sets):
@@ -637,8 +674,24 @@ class GBDT:
                 nleaves.append(tree.num_leaves)
             out_score = jnp.stack(new_scores)
             out_valid = tuple(tuple(v) for v in new_valid)
+            if nan_policy == "none":
+                return (out_score, out_valid, mask, tuple(trees),
+                        jnp.stack(nleaves), it + 1)
+            nf = jnp.stack([bad_g, bad_h, bad_leaf])
+            if nan_policy in ("raise", "skip_iter"):
+                # hardware-gate every output on the poison flag: a poisoned
+                # iteration leaves scores/masks BIT-identical to their
+                # pre-step values, so host-side recovery is pure bookkeeping
+                # (pop the no-op iteration), never NaN arithmetic
+                bad = jnp.any(nf)
+                out_score = jnp.where(bad, score, out_score)
+                out_valid = tuple(
+                    tuple(jnp.where(bad, old_k, new_k)
+                          for old_k, new_k in zip(old_vs, new_vs))
+                    for old_vs, new_vs in zip(valid_scores, out_valid))
+                mask = jnp.where(bad, bag_mask, mask)
             return (out_score, out_valid, mask, tuple(trees),
-                    jnp.stack(nleaves), it + 1)
+                    jnp.stack(nleaves), it + 1, nf)
 
         # donate the score buffers (positions: score=2, valid_scores=3) —
         # they are rebound to the step's outputs immediately after every
@@ -667,14 +720,66 @@ class GBDT:
         valid_scores = tuple(tuple(vs.score[k] for k in range(self.num_models))
                              for vs in self.valid_sets)
         consts, valid_Xb = self._step_consts()
-        score, out_valid, self.bag_mask, trees, nl, self._iter_dev = fn(
-            consts, valid_Xb, score, valid_scores, self.bag_mask,
-            self._rng_key, self._iter_dev, self._shrink_cache[1], *extra)
+        outs = fn(consts, valid_Xb, score, valid_scores, self.bag_mask,
+                  self._rng_key, self._iter_dev, self._shrink_cache[1], *extra)
+        nf = None
+        if self.nan_policy != "none":
+            score, out_valid, self.bag_mask, trees, nl, self._iter_dev, nf = outs
+        else:
+            score, out_valid, self.bag_mask, trees, nl, self._iter_dev = outs
         self.models.append(list(trees))
         self._num_leaves_dev.append(nl)
         self.iter_ += 1
         self.mutations_ = getattr(self, "mutations_", 0) + 1
+        if nf is not None:
+            try:
+                self._apply_nan_policy(nf)
+            except Exception:
+                # the pre-step buffers were DONATED to the step — rebind the
+                # (gated, bit-identical) outputs before propagating so the
+                # booster stays usable and checkpointable after the failure
+                self.score = score
+                for vi, vs in enumerate(self.valid_sets):
+                    vs.score = jnp.stack(out_valid[vi])
+                raise
         return score, out_valid
+
+    @allowed_host_sync("nan_policy guard: one 3-bool flag fetch per "
+                       "iteration, only while the guard is enabled")
+    def _apply_nan_policy(self, nf) -> bool:
+        """Host-side leg of the non-finite guard: fetch the step's three
+        detection flags and enforce self.nan_policy. Under raise/skip_iter
+        the step already gated every array output to its pre-step value, so
+        recovery here is pure bookkeeping. Returns True iff the iteration
+        was dropped."""
+        flags = np.asarray(nf)
+        if not flags.any():
+            self._consecutive_skips = 0
+            return False
+        from ..robustness.numeric import FLAG_NAMES, NonFiniteError
+        what = ", ".join(n for n, f in zip(FLAG_NAMES, flags) if f)
+        if self.nan_policy == "clip":
+            Log.warning("nan_policy=clip: non-finite %s at iteration %d "
+                        "were sanitized (NaN->0, Inf->+/-cap)", what,
+                        self.iter_ - 1)
+            self._consecutive_skips = 0
+            return False
+        self._pop_last_iteration()
+        if self.nan_policy == "raise":
+            raise NonFiniteError(
+                f"non-finite {what} detected at iteration {self.iter_} "
+                f"(nan_policy=raise); booster state is rolled back to the "
+                f"last clean iteration and remains checkpointable")
+        self._consecutive_skips += 1
+        Log.warning("nan_policy=skip_iter: dropped iteration %d "
+                    "(non-finite %s); %d consecutive skip(s)", self.iter_,
+                    what, self._consecutive_skips)
+        if self._consecutive_skips >= 10:
+            raise NonFiniteError(
+                f"nan_policy=skip_iter: {self._consecutive_skips} "
+                f"consecutive iterations produced non-finite {what} — the "
+                f"poison is deterministic, aborting instead of spinning")
+        return True
 
     def train_one_iter(self) -> None:
         with TIMERS("train_step"):
@@ -784,6 +889,11 @@ class GBDT:
                 or old.bagging_fraction != new_config.bagging_fraction
                 or old.feature_fraction != new_config.feature_fraction):
             retrace = True
+        if old.nan_policy != new_config.nan_policy:
+            # the guard is a trace-time constant: toggling it changes the
+            # step program (and its output arity)
+            self.nan_policy = new_config.nan_policy
+            retrace = True
         if old.feature_fraction != new_config.feature_fraction:
             F = self.train_set.num_features
             self.n_feature_sample = max(
@@ -794,6 +904,17 @@ class GBDT:
             self._step_fn = None
             self._custom_step_fn = None
 
+    def _pop_last_iteration(self) -> None:
+        """Drop the last appended iteration's bookkeeping WITHOUT score
+        arithmetic — for iterations whose contribution never reached the
+        scores (the no-splits pop; a nan_policy-gated no-op step). Contrast
+        rollback_one_iter, which also subtracts the trees' contribution."""
+        self.models.pop()
+        self._num_leaves_dev.pop()
+        self.iter_ -= 1
+        self.mutations_ = getattr(self, "mutations_", 0) + 1
+        self._iter_dev = None           # device counter resyncs next step
+
     def _check_no_splits(self) -> bool:
         """Reference gbdt.cpp:465-471: pop the iteration and stop when no tree
         could split."""
@@ -803,11 +924,7 @@ class GBDT:
         if (nl <= 1).all():
             Log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements.")
-            self.models.pop()
-            self._num_leaves_dev.pop()
-            self.iter_ -= 1
-            self.mutations_ = getattr(self, "mutations_", 0) + 1
-            self._iter_dev = None       # device counter resyncs next step
+            self._pop_last_iteration()
             return True
         return False
 
@@ -898,6 +1015,83 @@ class GBDT:
             # RF scores are already averages of converted outputs (rf.hpp)
             return score
         return self.objective.convert_output(score)
+
+    # ------------------------------------- checkpoint (robustness/checkpoint)
+
+    @allowed_host_sync("checkpoint snapshot: full training-state fetch at an "
+                       "iteration boundary, on demand only")
+    def checkpoint_state(self) -> Dict:
+        """Every array/counter the training step reads or writes, as host
+        values (the ``state`` field of a checkpoint payload): raw scores,
+        the carried bagging mask, the raw RNG key, the device forest
+        (TreeArrays pytrees), per-iteration leaf counts, and the iteration/
+        mutation counters. ``restore_checkpoint_state`` replays them so
+        continued training is bit-identical to a never-interrupted run."""
+        return {
+            "iter": int(self.iter_),
+            "data_fingerprint": self._data_fingerprint,
+            "mutations": int(getattr(self, "mutations_", 0)),
+            "consecutive_skips": int(self._consecutive_skips),
+            "num_data": int(self.num_data),
+            "num_data_padded": int(self.num_data_padded),
+            "num_models": int(self.num_models),
+            "init_score_value": float(self.init_score_value),
+            "score": np.asarray(self._fetch(self.score), np.float32),
+            "bag_mask": np.asarray(self._fetch(self.bag_mask), np.float32),
+            "rng_key": np.asarray(self._rng_key),
+            "models": jax.device_get(self.models),
+            "num_leaves": jax.device_get(self._num_leaves_dev),
+            "valid_scores": {vs.name: np.asarray(vs.score)
+                             for vs in self.valid_sets},
+            "best_iteration": int(getattr(self, "best_iteration", 0)),
+        }
+
+    def restore_checkpoint_state(self, state: Dict) -> None:
+        """Inverse of ``checkpoint_state``: replay a snapshot into this
+        booster. Shape mismatches fail loudly. Restored arrays are placed
+        with the same sharding kinds construction used, so an
+        already-compiled step keeps hitting its executable — resume costs
+        the normal first-step compile and nothing more (RecompileGuard-
+        verified in ``bench.py --smoke``)."""
+        for name, mine in (("num_data", self.num_data),
+                           ("num_data_padded", self.num_data_padded),
+                           ("num_models", self.num_models)):
+            if int(state[name]) != int(mine):
+                Log.fatal("checkpoint/booster mismatch: %s is %d in the "
+                          "snapshot but %d here — resume needs the same "
+                          "dataset and training config", name,
+                          int(state[name]), int(mine))
+        fp = state.get("data_fingerprint")
+        if fp and fp != self._data_fingerprint:
+            Log.fatal("checkpoint/dataset mismatch: the snapshot was written "
+                      "against different training data (binned-code/label "
+                      "fingerprint differs) — a shape-compatible but "
+                      "different dataset would silently corrupt the resumed "
+                      "model")
+        self.score = self._put(np.asarray(state["score"], np.float32),
+                               "rows1")
+        self.bag_mask = self._put(np.asarray(state["bag_mask"], np.float32),
+                                  "rows")
+        self._rng_key = self._put(np.asarray(state["rng_key"]))
+        self.models = [[jax.tree.map(self._put, t) for t in it_trees]
+                       for it_trees in state["models"]]
+        self._num_leaves_dev = [self._put(nl) for nl in state["num_leaves"]]
+        self.iter_ = int(state["iter"])
+        self.mutations_ = int(state["mutations"])
+        self._consecutive_skips = int(state.get("consecutive_skips", 0))
+        self.init_score_value = float(state["init_score_value"])
+        self.best_iteration = int(state.get("best_iteration", 0))
+        self._iter_dev = None           # device counter resyncs next step
+        self._shrink_cache = (None, None)
+        restored = state.get("valid_scores", {})
+        for vs in self.valid_sets:
+            if vs.name in restored:
+                vs.score = self._put(
+                    np.asarray(restored[vs.name], np.float32))
+            else:
+                Log.warning("checkpoint has no saved scores for valid set "
+                            "%r — its eval scores restart from the initial "
+                            "model", vs.name)
 
     # ------------------------------------------------------------------ model
 

@@ -94,6 +94,41 @@ def run_train(params: Dict) -> None:
         valid_sets.append(_load_dataset(vf, params, config, reference=train_set))
         valid_names.append(f"valid_{i + 1}" if len(config.valid_data) > 1 else "valid_1")
     callbacks = []
+    saved_handlers = []
+    if config.checkpoint_dir:
+        # preemption-friendly runs (docs/Fault-Tolerance.md): SIGTERM/SIGINT
+        # request an on-demand atomic checkpoint at the next iteration
+        # boundary, then exit 143 — restarting the identical command with
+        # resume_from=auto continues bit-identically. A SECOND signal
+        # escalates (KeyboardInterrupt) so a hung iteration — where the
+        # boundary never arrives — stays interruptible without SIGKILL.
+        import signal
+
+        stop_signals: List[int] = []
+
+        def _on_signal(signum, frame):
+            stop_signals.append(signum)
+            if len(stop_signals) > 1:
+                Log.warning("signal %d received again before an iteration "
+                            "boundary: aborting without a checkpoint", signum)
+                raise KeyboardInterrupt
+            Log.warning("signal %d received: writing a checkpoint at the "
+                        "next iteration boundary, then exiting", signum)
+
+        for _sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                saved_handlers.append((_sig, signal.signal(_sig, _on_signal)))
+            except ValueError:       # non-main thread (embedded use)
+                pass
+
+        def _signal_checkpoint(env):
+            if stop_signals:
+                path = env.model.save_checkpoint()
+                Log.warning("checkpoint %s written on signal %d; exiting",
+                            path, stop_signals[0])
+                raise SystemExit(143)
+        _signal_checkpoint.order = 50
+        callbacks.append(_signal_checkpoint)
     if config.snapshot_freq > 0:
         # reference: model.snapshot_iter_N every snapshot_freq iterations
         # during training (gbdt.cpp:349-353, config.h:103)
@@ -104,12 +139,21 @@ def run_train(params: Dict) -> None:
                 env.model.save_model(f"{config.output_model}.snapshot_iter_{it}")
         _snapshot.order = 30
         callbacks.append(_snapshot)
-    booster = train_fn(params, train_set,
-                       num_boost_round=config.num_iterations,
-                       valid_sets=valid_sets, valid_names=valid_names,
-                       init_model=config.input_model or None,
-                       early_stopping_rounds=config.early_stopping_round or None,
-                       callbacks=callbacks)
+    try:
+        booster = train_fn(params, train_set,
+                           num_boost_round=config.num_iterations,
+                           valid_sets=valid_sets, valid_names=valid_names,
+                           init_model=config.input_model or None,
+                           early_stopping_rounds=config.early_stopping_round
+                           or None,
+                           callbacks=callbacks)
+    finally:
+        if saved_handlers:
+            # past the training loop nothing checks stop_signals — restore
+            # the previous handlers so model save/predict stay interruptible
+            import signal
+            for _sig, _old in saved_handlers:
+                signal.signal(_sig, _old)
     booster.save_model(config.output_model)
     Log.info("Finished training, model saved to %s", config.output_model)
 

@@ -307,6 +307,21 @@ class Config:
     tpu_time_tag: bool = False
     tpu_profile_dir: str = ""
 
+    # --- fault tolerance (robustness/, docs/Fault-Tolerance.md) -------------
+    # directory of atomic booster snapshots (ckpt_<id>.pkl); empty = off
+    checkpoint_dir: str = ""
+    # save a snapshot every N iterations during train() (0 = only on demand)
+    checkpoint_interval: int = 0
+    # snapshots retained after each save (0 = keep everything)
+    checkpoint_keep_last_n: int = 3
+    # checkpoint file/dir to resume from; "auto" = latest in checkpoint_dir
+    # if any exist, else start fresh (the preemption-restart idiom: rerun
+    # the identical command line)
+    resume_from: str = ""
+    # non-finite gradient/hessian/leaf-output guard compiled into the
+    # training step: none (off) | raise | skip_iter | clip
+    nan_policy: str = "none"
+
     def __post_init__(self):
         self._check()
 
@@ -361,6 +376,26 @@ class Config:
             Log.fatal("Number of classes should be > 1 for multiclass training")
         if self.top_rate + self.other_rate > 1.0:
             Log.fatal("top_rate + other_rate cannot be larger than 1.0 for GOSS")
+        if self.nan_policy not in ("none", "raise", "skip_iter", "clip"):
+            Log.fatal("Unknown nan_policy %s (none|raise|skip_iter|clip)",
+                      self.nan_policy)
+        if self.checkpoint_interval < 0:
+            Log.fatal("checkpoint_interval must be >= 0, got %d",
+                      self.checkpoint_interval)
+        if self.checkpoint_keep_last_n < 0:
+            Log.fatal("checkpoint_keep_last_n must be >= 0, got %d",
+                      self.checkpoint_keep_last_n)
+        if self.checkpoint_interval > 0 and not self.checkpoint_dir:
+            Log.fatal("checkpoint_interval=%d needs checkpoint_dir to be set",
+                      self.checkpoint_interval)
+        if self.boosting_normalized == "dart" and (self.checkpoint_dir
+                                                   or self.resume_from):
+            # reject at config time, not at the first save: otherwise the
+            # interval/SIGTERM checkpoint machinery kills a dart run mid-
+            # flight instead of protecting it (host-side drop state is not
+            # captured by checkpoints)
+            Log.fatal("checkpoint/resume (checkpoint_dir/resume_from) is "
+                      "not supported with boosting=dart")
 
     # -- derived -------------------------------------------------------------
 

@@ -25,8 +25,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
           verbose_eval: Union[bool, int] = True,
           learning_rates=None,
           keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
-    """Mirror of reference engine.py:18 lgb.train."""
+          callbacks: Optional[List[Callable]] = None,
+          resume_from: Optional[str] = None) -> Booster:
+    """Mirror of reference engine.py:18 lgb.train.
+
+    Fault-tolerance additions (docs/Fault-Tolerance.md): ``resume_from``
+    (also settable as a param) replays a checkpoint written by
+    ``Booster.save_checkpoint`` before the first iteration — ``"auto"``
+    resumes the latest snapshot in ``checkpoint_dir`` when one exists and
+    starts fresh otherwise, so a preempted run restarts with the identical
+    command. With ``checkpoint_dir`` + ``checkpoint_interval`` set, a
+    snapshot is written every N iterations."""
     params = dict(params or {})
     if "num_iterations" not in params and "num_boost_round" not in params:
         params["num_iterations"] = num_boost_round
@@ -92,7 +101,36 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster._gbdt.add_base_score(raw, valid_raw)
         booster._prev_trees = list(prev_booster.trees[: n_prev_iters * Kp])
 
+    # ---- checkpoint/resume (robustness/checkpoint.py) ----------------------
+    resume_from = resume_from or config.resume_from or None
+    start_iter = 0
+    if resume_from:
+        if prev_booster is not None:
+            Log.fatal("resume_from cannot be combined with init_model — a "
+                      "checkpoint already contains the full training state")
+        resolved = resume_from
+        if resume_from == "auto":
+            from .robustness.checkpoint import CheckpointManager
+            resolved = (CheckpointManager(config.checkpoint_dir).latest()
+                        if config.checkpoint_dir else None)
+            if resolved is None:
+                Log.info("resume_from=auto: no checkpoint under %r — "
+                         "starting fresh", config.checkpoint_dir)
+        if resolved:
+            booster.resume(resolved)
+            start_iter = booster._gbdt.iter_
+            if start_iter >= n_rounds:
+                Log.warning("resumed checkpoint is already at iteration %d "
+                            ">= num_iterations=%d — no further training",
+                            start_iter, n_rounds)
+
     callbacks = list(callbacks or [])
+    if config.checkpoint_dir and config.checkpoint_interval > 0:
+        def _checkpoint_cb(env):
+            if (env.iteration + 1) % config.checkpoint_interval == 0:
+                env.model.save_checkpoint()
+        _checkpoint_cb.order = 40      # after record_evaluation (order 20):
+        callbacks.append(_checkpoint_cb)   # the snapshot sees this iter's eval
     if learning_rates is not None:
         # reference engine.py: list-or-callable schedule routed through
         # the reset_parameter callback
@@ -113,6 +151,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if evals_result is not None:
         from .callback import record_evaluation
         callbacks.append(record_evaluation(evals_result))
+    # the booster's own eval history is always recorded — checkpoints carry
+    # it so a resumed run's curves continue instead of restarting
+    from .callback import record_evaluation as _rec
+    callbacks.append(_rec(booster.eval_history))
     callbacks_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
     callbacks_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
@@ -126,7 +168,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         TIMERS.enabled = True
     try:
         with maybe_xla_trace(config.tpu_profile_dir):
-            for it in range(n_rounds):
+            for it in range(start_iter, n_rounds):
                 for cb in callbacks_before:
                     cb(CallbackEnv(booster, params, it, 0, n_rounds, None))
                 if fobj is not None:

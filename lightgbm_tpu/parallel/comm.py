@@ -463,7 +463,11 @@ def parse_machine_list(config) -> list:
     """Machine list as ``[(host, port), ...]`` from ``machines`` (comma- or
     newline-separated ``host:port`` / ``host port``) or ``machine_list_file``
     (reference: NetworkConfig, config.h:264-272; file format of
-    examples/parallel_learning/mlist.txt)."""
+    examples/parallel_learning/mlist.txt).
+
+    Each entry is validated individually: a malformed line (bare host, junk
+    port, empty host) raises a ValueError naming the offending entry and the
+    expected format instead of an opaque unpack/int() traceback."""
     text = config.machines or ""
     if not text and config.machine_list_file:
         with open(config.machine_list_file) as fh:
@@ -473,8 +477,23 @@ def parse_machine_list(config) -> list:
         chunk = chunk.strip()
         if not chunk:
             continue
-        host, port = chunk.split(":") if ":" in chunk else chunk.split()
-        out.append((host.strip(), int(port)))
+        if ":" in chunk:
+            host, _, port_s = chunk.partition(":")
+        else:
+            parts = chunk.split()
+            host, port_s = (parts[0], parts[1]) if len(parts) == 2 else \
+                (chunk, "")
+        host, port_s = host.strip(), port_s.strip()
+        try:
+            port = int(port_s)
+        except ValueError:
+            port = -1
+        if not host or ":" in port_s or not (0 < port < 65536):
+            raise ValueError(
+                f"malformed machine list entry {chunk!r}: expected "
+                f"'host:port' or 'host port' with port in 1..65535 "
+                f"(e.g. '10.0.0.1:12400')")
+        out.append((host, port))
     return out
 
 
@@ -505,8 +524,14 @@ def _local_rank(machines, local_listen_port: int) -> int:
 
 _host_allgather_seq = [0]
 
+# chaos-injection hook (robustness/chaos.py): when set, every KV client
+# host_allgather obtains is wrapped before use — fault paths become
+# exercisable on a real cluster without touching call sites
+_client_wrapper = None
 
-def host_allgather(obj, tag: str, timeout_ms: int = 600_000) -> list:
+
+def host_allgather(obj, tag: str, timeout_ms: int = 600_000, *,
+                   client=None, rank: int = None, world: int = None) -> list:
     """Gather one picklable object per process, returned rank-ordered.
 
     Host-side analog of the reference's Network::Allgather for setup-time
@@ -514,27 +539,90 @@ def host_allgather(obj, tag: str, timeout_ms: int = 600_000) -> list:
     pre-partitioned data, dataset_loader.cpp:159-221) — exchanged through
     jax's coordination-service KV store, not a hand-built TCP mesh. The call
     sequence must be identical on every process (SPMD), which makes the
-    per-tag sequence number agree."""
+    per-tag sequence number agree.
+
+    Resilience (docs/Fault-Tolerance.md): the KV set and each per-rank
+    get+unpickle are retried with exponential backoff + jitter
+    (``LGBM_TPU_COMM_*`` env knobs) — a transient coordination-service
+    hiccup or a corrupted payload re-fetches instead of killing the run —
+    and exhausted retries raise a ``CommTimeoutError`` naming the tag,
+    sequence number, and both ranks. Cleanup failures are *logged*, never
+    swallowed, and this rank's key is deleted only when the done-barrier
+    actually succeeded (deleting earlier races peers still reading).
+
+    ``client``/``rank``/``world`` are injectable for tests and the chaos
+    harness (robustness/chaos.py FakeKVStore / ChaosKVClient); they default
+    to the live jax.distributed state.
+    """
     import pickle
 
-    client = distributed_client()
-    if client is None or jax.process_count() <= 1:
+    from ..robustness.retry import (CommTimeoutError, comm_attempts,
+                                    retry_call)
+    from ..utils.log import Log
+
+    if client is None:
+        client = distributed_client()
+        if client is None or jax.process_count() <= 1:
+            return [obj]
+    if _client_wrapper is not None:
+        client = _client_wrapper(client)
+    rank = jax.process_index() if rank is None else rank
+    world = jax.process_count() if world is None else world
+    if world <= 1:
         return [obj]
-    rank, world = jax.process_index(), jax.process_count()
     seq = _host_allgather_seq[0]
     _host_allgather_seq[0] += 1
     key = f"lgbm_hostgather/{tag}/{seq}"
-    client.key_value_set_bytes(f"{key}/{rank}", pickle.dumps(obj))
+    payload = pickle.dumps(obj)
+    # allow_overwrite makes the retried set idempotent: a first attempt that
+    # landed server-side but lost its ack re-writes the identical payload
+    # instead of failing every retry with ALREADY_EXISTS
+    retry_call(lambda: client.key_value_set_bytes(f"{key}/{rank}", payload,
+                                                  allow_overwrite=True),
+               what=f"host_allgather set tag={tag!r} seq={seq} rank={rank}")
     out = []
+    # the timeout is a TOTAL budget per peer, split across retry attempts —
+    # a dead peer costs ~timeout_ms, not attempts x timeout_ms (retrying
+    # only pays off for the transient-error/corrupt-payload cases anyway)
+    per_attempt_ms = max(1, timeout_ms // comm_attempts())
     for r in range(world):
-        out.append(obj if r == rank else pickle.loads(
-            client.blocking_key_value_get_bytes(f"{key}/{r}", timeout_ms)))
+        if r == rank:
+            out.append(obj)
+            continue
+
+        def _get(r=r):
+            # get + unpickle as ONE retried unit: a transiently corrupted
+            # payload (bit rot in flight) re-fetches cleanly
+            raw = client.blocking_key_value_get_bytes(f"{key}/{r}",
+                                                      per_attempt_ms)
+            return pickle.loads(raw)
+
+        try:
+            out.append(retry_call(
+                _get, what=f"host_allgather get tag={tag!r} seq={seq} "
+                           f"rank={rank}<-{r}"))
+        except Exception as e:
+            raise CommTimeoutError(
+                f"host_allgather tag={tag!r} seq={seq}: rank {rank} could "
+                f"not fetch rank {r}'s shard within ~{timeout_ms} ms total "
+                f"over {e.__class__.__name__}: {e}") from e
+    # every rank must have READ every shard before any key disappears
+    barrier_ok = False
     try:
-        # every rank must have READ every shard before any key disappears
         client.wait_at_barrier(f"{key}/done", timeout_ms)
-        client.key_value_delete(f"{key}/{rank}")
-    except Exception:
-        pass                         # best-effort server-side cleanup
+        barrier_ok = True
+    except Exception as e:                                   # noqa: BLE001
+        Log.warning("host_allgather tag=%r seq=%d rank=%d: cleanup barrier "
+                    "failed (%s: %s); leaving key %s/%d for the coordination "
+                    "service to expire", tag, seq, rank,
+                    type(e).__name__, e, key, rank)
+    if barrier_ok:
+        try:
+            client.key_value_delete(f"{key}/{rank}")
+        except Exception as e:                               # noqa: BLE001
+            Log.warning("host_allgather tag=%r seq=%d rank=%d: key delete "
+                        "failed (%s: %s)", tag, seq, rank,
+                        type(e).__name__, e)
     return out
 
 
@@ -565,11 +653,54 @@ def init_distributed(config) -> bool:
                     "using the list", config.num_machines, len(machines))
     rank = _local_rank(machines, config.local_listen_port)
     coord = f"{machines[0][0]}:{machines[0][1]}"
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=len(machines),
-                               process_id=rank,
-                               # reference time_out is MINUTES (config.h:272)
-                               initialization_timeout=config.time_out * 60)
+    from ..robustness.retry import CommTimeoutError, retry_call
+
+    def _reset_partial_init():
+        # a failed connect() leaves jax's global_state.client (and rank 0's
+        # service) assigned, so a bare re-call of initialize() raises
+        # 'should only be called once' instead of retrying the handshake —
+        # tear the partial state down between attempts
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:                               # noqa: BLE001
+            from ..utils.log import Log
+            Log.debug("init_distributed: shutdown after failed attempt "
+                      "itself failed (%s: %s); clearing state directly",
+                      type(e).__name__, e)
+            try:
+                from jax._src import distributed as _dist
+                _dist.global_state.client = None
+                _dist.global_state.service = None
+                _dist.global_state.preemption_sync_manager = None
+            except Exception:                                # noqa: BLE001
+                pass
+
+    def _initialize():
+        try:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=len(machines),
+                                       process_id=rank,
+                                       # reference time_out is MINUTES
+                                       # (config.h:272)
+                                       initialization_timeout=config.time_out
+                                       * 60)
+        except Exception:
+            _reset_partial_init()
+            raise
+
+    # pod-startup churn routinely loses the first coordination-service
+    # handshake (the coordinator container comes up seconds after the
+    # workers) — retry with backoff instead of dying on attempt one
+    try:
+        retry_call(_initialize,
+                   what=f"jax.distributed.initialize coordinator={coord} "
+                        f"rank={rank}/{len(machines)}")
+    except Exception as e:
+        raise CommTimeoutError(
+            f"init_distributed: rank {rank} could not join the "
+            f"coordination service at {coord} "
+            f"(world size {len(machines)}, timeout {config.time_out} min): "
+            f"{type(e).__name__}: {e}") from e
     return jax.process_count() > 1
 
 

@@ -1,0 +1,245 @@
+"""Deterministic fault injection for the resilience layer (``make chaos``).
+
+Every degradation path the robustness subsystem claims to survive is
+exercisable on the hermetic CPU harness, without a real cluster:
+
+- ``FakeKVStore``    — an in-process stand-in for the jax coordination-
+  service client surface (``key_value_set_bytes`` /
+  ``blocking_key_value_get_bytes`` / ``wait_at_barrier`` /
+  ``key_value_delete``) that ``parallel/comm.py:host_allgather`` accepts
+  through its injectable ``client=`` parameter.
+- ``ChaosKVClient``  — wraps any client (fake or real) and injects KV
+  delays, drops (raised errors), and pickled-payload corruption. Faults
+  fire either at explicit 0-based call indices (``delay_gets=(0, 2)`` —
+  exact, reproducible scripts for tests) or probabilistically under a
+  seeded RNG (``seed`` + ``*_prob`` — soak mode); both are deterministic
+  for a fixed seed. Injected events are recorded on ``.events``.
+- ``nan_gradient_fobj`` — a custom-objective wrapper that poisons chosen
+  iterations' gradients with NaN/Inf, driving the ``nan_policy`` branches
+  (raise | skip_iter | clip) end-to-end through ``engine.train``.
+
+The default seed comes from ``LGBM_TPU_CHAOS_SEED`` (the ``make chaos``
+target pins it) so a failing chaos run is replayable bit-for-bit.
+"""
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+
+
+def default_seed() -> int:
+    try:
+        return int(os.environ.get("LGBM_TPU_CHAOS_SEED", "1234"))
+    except ValueError:
+        return 1234
+
+
+class ChaosInjectedError(RuntimeError):
+    """A deliberately injected fault (distinguishable from real failures)."""
+
+
+class KVTimeoutSim(ChaosInjectedError):
+    """Simulated coordination-service timeout (a dropped KV exchange)."""
+
+
+@dataclass
+class ChaosPlan:
+    """What to inject, and when. Explicit index tuples are 0-based call
+    counts per operation kind; probabilistic knobs draw from a RNG seeded
+    with ``seed`` so a plan replays identically."""
+    seed: int = field(default_factory=default_seed)
+    # explicit, scripted faults (exact call indices)
+    delay_gets: Tuple[int, ...] = ()
+    drop_gets: Tuple[int, ...] = ()
+    corrupt_gets: Tuple[int, ...] = ()
+    drop_sets: Tuple[int, ...] = ()
+    drop_barriers: Tuple[int, ...] = ()
+    # probabilistic soak mode
+    kv_delay_prob: float = 0.0
+    kv_drop_prob: float = 0.0
+    kv_corrupt_prob: float = 0.0
+    delay_seconds: float = 0.01
+
+
+class ChaosKVClient:
+    """Coordination-service client wrapper injecting faults per ChaosPlan."""
+
+    def __init__(self, inner, plan: Optional[ChaosPlan] = None):
+        self.inner = inner
+        self.plan = plan or ChaosPlan()
+        self._rng = random.Random(self.plan.seed)
+        self._calls = {"set": 0, "get": 0, "barrier": 0}
+        self.events: List[Tuple[str, str, str]] = []   # (fault, op, key)
+
+    def _record(self, fault: str, op: str, key: str) -> None:
+        self.events.append((fault, op, key))
+        Log.debug("chaos: injected %s on %s %s", fault, op, key)
+
+    def _fault(self, op: str, key: str, scripted_drop: Sequence[int],
+               scripted_delay: Sequence[int] = ()) -> None:
+        i = self._calls[op]
+        self._calls[op] += 1
+        if i in scripted_delay or self._rng.random() < self.plan.kv_delay_prob:
+            self._record("delay", op, key)
+            time.sleep(self.plan.delay_seconds)
+        if i in scripted_drop or self._rng.random() < self.plan.kv_drop_prob:
+            self._record("drop", op, key)
+            raise KVTimeoutSim(
+                f"chaos: injected {op} drop for key {key!r} (call #{i})")
+
+    # ---- the client surface host_allgather / retry_call exercise --------
+
+    def key_value_set_bytes(self, key: str, value: bytes,
+                            allow_overwrite: bool = False):
+        self._fault("set", key, self.plan.drop_sets)
+        return self.inner.key_value_set_bytes(
+            key, value, allow_overwrite=allow_overwrite)
+
+    def blocking_key_value_get_bytes(self, key: str, timeout_ms: int) -> bytes:
+        i = self._calls["get"]         # _fault advances the counter
+        self._fault("get", key, self.plan.drop_gets, self.plan.delay_gets)
+        raw = self.inner.blocking_key_value_get_bytes(key, timeout_ms)
+        if (i in self.plan.corrupt_gets
+                or self._rng.random() < self.plan.kv_corrupt_prob):
+            self._record("corrupt", "get", key)
+            raw = corrupt_payload(raw, seed=self.plan.seed + i)
+        return raw
+
+    def wait_at_barrier(self, key: str, timeout_ms: int):
+        self._fault("barrier", key, self.plan.drop_barriers)
+        return self.inner.wait_at_barrier(key, timeout_ms)
+
+    def key_value_delete(self, key: str):
+        return self.inner.key_value_delete(key)
+
+
+def corrupt_payload(raw: bytes, seed: int = 0) -> bytes:
+    """Deterministically flip bytes of a pickled payload so unpickling (or
+    schema validation) fails — the 'bit-rotted KV value' scenario."""
+    if not raw:
+        return b"\x80"                           # truncated pickle opcode
+    rng = random.Random(seed)
+    buf = bytearray(raw)
+    for _ in range(max(1, len(buf) // 16)):
+        pos = rng.randrange(len(buf))
+        buf[pos] ^= 0xFF
+    # also chop the tail: pickle.loads must not luck into success
+    return bytes(buf[: max(1, len(buf) - 2)])
+
+
+class FakeKVStore:
+    """In-process coordination-service double for single-process tests.
+
+    Pre-populate peer ranks' shards via ``store.preload(key, value)`` (or
+    the ``entries=`` ctor arg); a blocking get polls until the key appears
+    or the (real-time) timeout expires, raising ``TimeoutError`` like the
+    real client. ``barrier_fails=True`` simulates a peer that never reaches
+    the cleanup barrier.
+    """
+
+    def __init__(self, entries=None, barrier_fails: bool = False,
+                 poll_interval: float = 0.001):
+        self.data = dict(entries or {})
+        self.barrier_fails = barrier_fails
+        self.poll_interval = poll_interval
+        self.barrier_waits: List[str] = []
+        self.deleted: List[str] = []
+
+    def preload(self, key: str, value: bytes) -> "FakeKVStore":
+        self.data[key] = value
+        return self
+
+    def key_value_set_bytes(self, key: str, value: bytes,
+                            allow_overwrite: bool = False) -> None:
+        if key in self.data and not allow_overwrite:
+            raise ValueError(f"FakeKVStore: key {key!r} already exists "
+                             f"(allow_overwrite=False)")   # like the real client
+        self.data[key] = value
+
+    def blocking_key_value_get_bytes(self, key: str, timeout_ms: int) -> bytes:
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            if key in self.data:
+                return self.data[key]
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"FakeKVStore: key {key!r} not set within {timeout_ms} ms")
+            time.sleep(self.poll_interval)
+
+    def wait_at_barrier(self, key: str, timeout_ms: int) -> None:
+        self.barrier_waits.append(key)
+        if self.barrier_fails:
+            raise TimeoutError(
+                f"FakeKVStore: barrier {key!r} timed out after {timeout_ms} ms")
+
+    def key_value_delete(self, key: str) -> None:
+        self.deleted.append(key)
+        self.data.pop(key, None)
+
+
+# ------------------------------------------------------ live-cluster hook
+
+def install_kv_chaos(plan: Optional[ChaosPlan] = None):
+    """Point ``parallel.comm._client_wrapper`` at a ChaosKVClient factory so
+    every KV client ``host_allgather`` obtains is fault-wrapped — chaos on a
+    real (or fake) cluster without touching call sites. One ChaosKVClient is
+    kept per underlying client so fault call-counters survive across calls.
+    Returns the wrapper; its ``.clients`` dict exposes the live ChaosKVClient
+    instances (for ``.events`` inspection). Undo with uninstall_kv_chaos()."""
+    from ..parallel import comm
+
+    wrapped = {}
+
+    def wrapper(inner):
+        cl = wrapped.get(id(inner))
+        if cl is None:
+            cl = wrapped[id(inner)] = ChaosKVClient(inner, plan)
+        return cl
+
+    wrapper.clients = wrapped
+    comm._client_wrapper = wrapper
+    return wrapper
+
+
+def uninstall_kv_chaos() -> None:
+    from ..parallel import comm
+    comm._client_wrapper = None
+
+
+# --------------------------------------------------------------- gradients
+
+def nan_gradient_fobj(bad_iters: Sequence[int], mode: str = "nan",
+                      frac: float = 0.05, seed: Optional[int] = None):
+    """A reference-contract ``fobj(preds, train_data) -> (grad, hess)`` for
+    squared loss that poisons ``frac`` of the gradients with NaN (or +Inf,
+    ``mode="inf"``) at the chosen 0-based iterations — the forced-NaN leg
+    of the chaos suite, driving every ``nan_policy`` branch.
+    """
+    bad = set(int(i) for i in bad_iters)
+    rng = np.random.RandomState(default_seed() if seed is None else seed)
+    poison = np.nan if mode == "nan" else np.inf
+    state = {"it": 0}
+
+    def fobj(preds, train_data):
+        y = np.asarray(train_data.get_label(), np.float32)
+        preds = np.asarray(preds, np.float32).reshape(y.shape)
+        grad = preds - y
+        hess = np.ones_like(grad)
+        if state["it"] in bad:
+            k = max(1, int(len(grad) * frac))
+            idx = rng.choice(len(grad), size=k, replace=False)
+            grad = grad.copy()
+            grad[idx] = poison
+            Log.debug("chaos: poisoned %d gradients with %s at iteration %d",
+                      k, poison, state["it"])
+        state["it"] += 1
+        return grad, hess
+
+    return fobj

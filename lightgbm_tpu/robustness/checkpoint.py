@@ -1,0 +1,217 @@
+"""Atomic checkpoint store for booster training state.
+
+Layout: one pickle file per snapshot inside ``checkpoint_dir``,
+
+    ckpt_0000000001.pkl
+    ckpt_0000000002.pkl
+    ...
+
+with monotonically increasing checkpoint ids (the id is derived from the
+files already present, so a resumed process keeps counting where the killed
+one stopped). Writes are write-temp-then-``os.replace`` with an fsync in
+between: a preemption mid-write can never leave a truncated file behind
+that parses as a checkpoint — at worst an orphaned ``*.tmp.*`` that the
+next save sweeps up. ``keep_last_n`` prunes old snapshots after every
+successful save (0 keeps everything).
+
+Each payload carries a **config fingerprint** — a SHA-256 over the
+training-semantics subset of the Config — and resume fails loudly when the
+fingerprint of the resuming booster differs, naming the mismatched fields.
+Run-control fields (paths, verbosity, the checkpoint knobs themselves,
+``num_iterations`` so a run can be resumed *longer*) are excluded from the
+fingerprint.
+
+The payload schema (``FORMAT_VERSION`` 1)::
+
+    {"format_version": 1, "checkpoint_id": int,
+     "config_fingerprint": str, "config": {trainable-subset dict},
+     "iteration": int, "state": {GBDT.checkpoint_state()},
+     "booster": {...}, "eval_history": {...}}
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.log import Log
+
+FORMAT_VERSION = 1
+
+_FILE_RE = re.compile(r"^ckpt_(\d{10})\.pkl$")
+
+# Config fields with no bearing on the trained model's content: two runs
+# differing only here are resumable into each other. Everything else is
+# fingerprinted — a silent objective/num_leaves/seed change across a resume
+# is exactly the corruption this check exists to catch.
+VOLATILE_CONFIG_FIELDS = frozenset({
+    # run control / IO
+    "task", "data", "valid_data", "init_score_file",
+    "valid_init_score_file", "snapshot_freq", "output_model",
+    "output_result", "convert_model", "convert_model_language",
+    "input_model", "model_format", "num_iteration_predict",
+    "is_predict_leaf_index", "is_predict_contrib", "is_predict_raw_score",
+    "is_save_binary_file", "verbose", "num_threads",
+    # resuming a run LONGER than originally planned is the point
+    "num_iterations",
+    # checkpointing's own knobs
+    "checkpoint_dir", "checkpoint_interval", "checkpoint_keep_last_n",
+    "resume_from",
+    # cluster wiring: the restarted pod gets fresh addresses/ports
+    "machines", "machine_list_file", "local_listen_port", "time_out",
+    # profiling/telemetry
+    "tpu_time_tag", "tpu_profile_dir",
+})
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be written, located, parsed, or validated."""
+
+
+def fingerprinted_config(config) -> Dict:
+    """The training-semantics subset of ``config`` that the fingerprint
+    covers (and that is stored in the payload for mismatch diagnostics)."""
+    return {k: v for k, v in config.to_dict().items()
+            if k not in VOLATILE_CONFIG_FIELDS}
+
+
+def config_fingerprint(config) -> str:
+    """SHA-256 over the canonical JSON of the non-volatile config fields."""
+    blob = json.dumps(fingerprinted_config(config), sort_keys=True,
+                      default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def config_mismatch_fields(stored: Dict, config) -> List[str]:
+    """Field names whose stored value differs from ``config``'s."""
+    current = fingerprinted_config(config)
+    keys = set(stored) | set(current)
+    return sorted(k for k in keys
+                  if stored.get(k, "<missing>") != current.get(k, "<missing>"))
+
+
+class CheckpointManager:
+    """Directory of atomically-written, monotonically-numbered snapshots."""
+
+    def __init__(self, directory: str, keep_last_n: int = 3):
+        if not directory:
+            raise CheckpointError("checkpoint_dir is empty — set "
+                                  "checkpoint_dir=... (docs/Fault-Tolerance.md)")
+        if keep_last_n < 0:
+            raise CheckpointError(f"keep_last_n must be >= 0, got {keep_last_n}")
+        self.directory = directory
+        self.keep_last_n = keep_last_n
+
+    # ------------------------------------------------------------- listing
+
+    def list_checkpoints(self) -> List[Tuple[int, str]]:
+        """``[(checkpoint_id, path)]`` sorted ascending by id."""
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            m = _FILE_RE.match(name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, name)))
+        out.sort()
+        return out
+
+    def latest(self) -> Optional[str]:
+        cks = self.list_checkpoints()
+        return cks[-1][1] if cks else None
+
+    # -------------------------------------------------------------- saving
+
+    def save(self, payload: Dict) -> str:
+        """Write one snapshot atomically; returns the final path."""
+        os.makedirs(self.directory, exist_ok=True)
+        existing = self.list_checkpoints()
+        ckpt_id = (existing[-1][0] + 1) if existing else 1
+        payload = dict(payload)
+        payload["format_version"] = FORMAT_VERSION
+        payload["checkpoint_id"] = ckpt_id
+        path = os.path.join(self.directory, f"ckpt_{ckpt_id:010d}.pkl")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as fh:
+                pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise CheckpointError(f"cannot write checkpoint {path}: {e}") from e
+        self._prune()
+        self._sweep_tmp()
+        return path
+
+    def _prune(self) -> None:
+        if self.keep_last_n <= 0:
+            return
+        cks = self.list_checkpoints()
+        for _id, path in cks[:-self.keep_last_n]:
+            try:
+                os.unlink(path)
+            except OSError as e:
+                Log.warning("cannot prune old checkpoint %s: %s", path, e)
+
+    def _sweep_tmp(self) -> None:
+        """Remove orphaned temp files from writers killed mid-snapshot."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if ".pkl.tmp." in name:
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------- loading
+
+    @staticmethod
+    def resolve(path_or_dir: str) -> str:
+        """A checkpoint file path, or the latest snapshot of a directory."""
+        if os.path.isdir(path_or_dir):
+            latest = CheckpointManager(path_or_dir).latest()
+            if latest is None:
+                raise CheckpointError(
+                    f"no checkpoints (ckpt_*.pkl) found in {path_or_dir}")
+            return latest
+        if not os.path.exists(path_or_dir):
+            raise CheckpointError(f"checkpoint {path_or_dir} does not exist")
+        return path_or_dir
+
+    @staticmethod
+    def load(path_or_dir: str) -> Dict:
+        """Load and schema-validate one snapshot (fails loudly on
+        truncation/corruption — a half-written pickle must never resume)."""
+        path = CheckpointManager.resolve(path_or_dir)
+        try:
+            with open(path, "rb") as fh:
+                payload = pickle.load(fh)
+        except (OSError, pickle.UnpicklingError, EOFError, AttributeError,
+                ImportError, IndexError, ValueError) as e:
+            raise CheckpointError(
+                f"cannot load checkpoint {path}: {type(e).__name__}: {e} "
+                f"(corrupt or truncated snapshot?)") from e
+        if not isinstance(payload, dict) or "format_version" not in payload:
+            raise CheckpointError(
+                f"{path} is not a lightgbm_tpu checkpoint (no format_version)")
+        if payload["format_version"] != FORMAT_VERSION:
+            raise CheckpointError(
+                f"{path} has format_version={payload['format_version']}; "
+                f"this build reads version {FORMAT_VERSION}")
+        for key in ("config_fingerprint", "config", "state", "iteration"):
+            if key not in payload:
+                raise CheckpointError(f"{path} is missing the {key!r} field "
+                                      f"— corrupt snapshot?")
+        return payload

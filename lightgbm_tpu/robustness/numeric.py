@@ -1,0 +1,51 @@
+"""Non-finite guards for the boosting step (``nan_policy`` semantics).
+
+An exploding objective (custom fobj bugs, extreme init_score, lr schedules
+gone wrong) poisons gradients/hessians with NaN/Inf; one poisoned iteration
+silently corrupts every later tree. The guard is compiled INTO the training
+step when ``nan_policy != "none"`` (boosting/gbdt.py):
+
+- detection reduces g/h/leaf outputs to three device booleans inside the
+  jitted step — the only extra host traffic is one tiny flag fetch per
+  iteration, and only while the guard is enabled;
+- under ``raise``/``skip_iter`` every step output is hardware-gated
+  (``jnp.where(bad, input, output)``) so a poisoned iteration leaves
+  scores/masks bit-identical to their pre-step values — host-side recovery
+  is pure bookkeeping (pop the no-op iteration), never NaN arithmetic;
+- ``clip`` sanitizes g/h and leaf outputs in-step (NaN -> 0,
+  +/-Inf -> +/-CLIP_CAP) and logs that it fired.
+
+Policies (config ``nan_policy``): ``none`` (default — guard compiled out,
+the step program is byte-identical to the unguarded one), ``raise`` (fail
+the run loudly, state left clean and checkpointable), ``skip_iter`` (drop
+the iteration via the rollback_one_iter bookkeeping and continue),
+``clip`` (sanitize and continue).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NAN_POLICIES = ("none", "raise", "skip_iter", "clip")
+
+
+class NonFiniteError(RuntimeError):
+    """nan_policy="raise": non-finite values detected in the boosting step.
+    Raised AFTER the poisoned iteration's no-op bookkeeping is popped, so the
+    booster state is clean and checkpointable at the failure point."""
+
+# finite stand-in for +/-Inf under nan_policy=clip: large enough to keep
+# ordering signal, small enough that squares/sums stay inside f32
+CLIP_CAP = 1e30
+
+FLAG_NAMES = ("gradients", "hessians", "leaf outputs")
+
+
+def nonfinite_flag(x) -> jnp.ndarray:
+    """Device scalar bool: any element of ``x`` is NaN/Inf."""
+    return ~jnp.all(jnp.isfinite(x))
+
+
+def clip_nonfinite(x, cap: float = CLIP_CAP):
+    """NaN -> 0, +/-Inf -> +/-cap, finite values untouched."""
+    return jnp.clip(jnp.nan_to_num(x, nan=0.0, posinf=cap, neginf=-cap),
+                    -cap, cap)

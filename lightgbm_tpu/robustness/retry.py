@@ -1,0 +1,99 @@
+"""Bounded retry with exponential backoff + jitter for host-side comm ops.
+
+The coordination-service KV exchanges in ``parallel/comm.py``
+(``host_allgather``, ``init_distributed``) previously made exactly one
+attempt: a transient coordination-service hiccup — routine during pod
+startup and preemption churn — killed the whole run. Every attempt here is
+logged (never swallowed), the final failure carries the operation
+description, and the backoff schedule is tunable through environment
+variables so operators can match it to their cluster's restart behavior:
+
+- ``LGBM_TPU_COMM_RETRIES``        total attempts (default 3)
+- ``LGBM_TPU_COMM_BACKOFF_BASE``   first delay, seconds (default 0.5)
+- ``LGBM_TPU_COMM_BACKOFF_MAX``    delay ceiling, seconds (default 30)
+- ``LGBM_TPU_COMM_BACKOFF_JITTER`` jitter fraction on top (default 0.25)
+
+Deterministic tests pass an explicitly seeded ``rng`` and a fake ``sleep``.
+"""
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from ..utils.log import Log
+
+
+class CommRetryError(RuntimeError):
+    """All retry attempts of a communication operation failed."""
+
+
+class CommTimeoutError(CommRetryError):
+    """A communication operation timed out waiting on a peer; the message
+    names the tag/sequence and both ranks involved."""
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        Log.warning("%s is not an integer; using default %d", name, default)
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        Log.warning("%s is not a number; using default %g", name, default)
+        return default
+
+
+def comm_attempts() -> int:
+    """Total attempts the ``LGBM_TPU_COMM_RETRIES`` knob currently specifies
+    — callers splitting a fixed timeout budget across attempts (the
+    ``host_allgather`` gets) read it through this."""
+    return max(1, _env_int("LGBM_TPU_COMM_RETRIES", 3))
+
+
+def retry_call(fn: Callable, *, what: str,
+               attempts: Optional[int] = None,
+               base_delay: Optional[float] = None,
+               max_delay: Optional[float] = None,
+               jitter: Optional[float] = None,
+               retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+               sleep: Callable[[float], None] = time.sleep,
+               rng: Optional[random.Random] = None):
+    """Call ``fn()`` with bounded retries; backoff doubles per attempt.
+
+    ``what`` names the operation in log lines and the terminal error
+    (e.g. ``"host_allgather get tag='efb_sample' seq=3 rank=0<-2"``).
+    Defaults come from the ``LGBM_TPU_COMM_*`` env knobs, read at call
+    time so tests and operators can adjust a live process.
+    """
+    attempts = attempts if attempts is not None else comm_attempts()
+    base = base_delay if base_delay is not None else \
+        _env_float("LGBM_TPU_COMM_BACKOFF_BASE", 0.5)
+    ceil = max_delay if max_delay is not None else \
+        _env_float("LGBM_TPU_COMM_BACKOFF_MAX", 30.0)
+    jit = jitter if jitter is not None else \
+        _env_float("LGBM_TPU_COMM_BACKOFF_JITTER", 0.25)
+    rng = rng if rng is not None else random
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:                                # noqa: PERF203
+            last = e
+            if attempt == attempts - 1:
+                break
+            delay = min(base * (2.0 ** attempt), ceil)
+            delay *= 1.0 + jit * rng.random()
+            Log.warning("%s failed (attempt %d/%d: %s: %s) — retrying in "
+                        "%.3fs", what, attempt + 1, attempts,
+                        type(last).__name__, last, delay)
+            sleep(delay)
+    raise CommRetryError(
+        f"{what} failed after {attempts} attempt(s): "
+        f"{type(last).__name__}: {last}") from last

@@ -37,6 +37,10 @@ def pytest_configure(config):
         "markers", "tpu: requires a real TPU backend (Mosaic lowering, "
                    "device transfer semantics); skipped under the hermetic "
                    "CPU harness / JAX_PLATFORMS=cpu")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection suite (robustness/chaos.py) — "
+                   "run via `make chaos` with a pinned LGBM_TPU_CHAOS_SEED; "
+                   "fast enough to ride in tier-1 too")
 
 
 def pytest_collection_modifyitems(config, items):

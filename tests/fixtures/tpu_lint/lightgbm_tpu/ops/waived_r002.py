@@ -1,0 +1,26 @@
+"""Fixture: the SAME host syncs as bad_r002.py, but inside a function
+carrying the ``@allowed_host_sync`` waiver — R002 must stay silent.
+
+The decorator (lightgbm_tpu/robustness) marks audited sync points (the
+checkpoint state fetch, the nan_policy flag fetch) where the sync IS the
+contract; both the bare and the dotted spelling must be recognized.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu import robustness
+from lightgbm_tpu.robustness import allowed_host_sync
+
+
+@allowed_host_sync("fixture: audited one-shot state fetch")
+def checkpoint_fetch(codes):
+    total = jnp.sum(codes)
+    host_total = float(total)          # waived: annotated sync point
+    np.asarray(total)                  # waived too
+    return host_total
+
+
+@robustness.allowed_host_sync("fixture: dotted decorator spelling")
+def flag_fetch(codes):
+    flag = jnp.any(codes > 0)
+    return bool(flag)                  # waived: annotated sync point

@@ -98,3 +98,45 @@ def test_stacked_forest_cache_alternating_slices():
     assert preds.shape == (400,)
     assert bst._forest_rev > rev0
     assert bst._stacked_forests(bst.trees, 1) is not f_full
+
+
+def test_checkpoint_rollback_resume_bit_identical(tmp_path):
+    """checkpoint -> train 2 more iters -> rollback -> resume -> retrain:
+    the rev-keyed LRU must never serve a pre-rollback/pre-resume forest
+    (same length, different provenance), and the resumed retrain must land
+    on predictions bit-identical to a straight-through run."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(1)
+    X = rng.rand(400, 5).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    params = dict(objective="binary", num_leaves=7, max_bin=31,
+                  min_data_in_leaf=5, verbose=-1, metric="none", seed=11,
+                  bagging_fraction=0.8, bagging_freq=1)
+
+    def fresh():
+        return lgb.Booster(params=params,
+                           train_set=lgb.Dataset(X, label=y, params=params))
+
+    straight = fresh()
+    for _ in range(5):
+        straight.update()
+    p_straight = straight.predict(X)
+
+    bst = fresh()
+    for _ in range(3):
+        bst.update()
+    bst.save_checkpoint(str(tmp_path))
+    for _ in range(2):
+        bst.update()
+    f5 = bst._stacked_forests(bst.trees, 1)       # cache the 5-tree forest
+    rev5 = bst._forest_rev
+    bst.rollback_one_iter()
+    assert bst.predict(X).shape == (400,)         # cache the 4-tree forest
+    bst.resume(str(tmp_path))                     # back to iteration 3
+    assert bst.num_trees() == 3
+    assert bst._forest_rev > rev5                 # stale entries unreachable
+    for _ in range(2):
+        bst.update()
+    p_resumed = bst.predict(X)
+    assert bst._stacked_forests(bst.trees, 1) is not f5
+    np.testing.assert_array_equal(p_resumed, p_straight)

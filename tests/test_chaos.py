@@ -1,0 +1,173 @@
+"""End-to-end fault-tolerance integration tests (docs/Fault-Tolerance.md):
+kill-and-resume bit-identity on the serial and data-parallel paths, the
+three ``nan_policy`` branches driven by chaos-injected NaN/Inf gradients
+through ``engine.train``, and the loud config-fingerprint mismatch.
+
+Run with ``make chaos`` (pinned LGBM_TPU_CHAOS_SEED); fast enough to ride
+inside tier-1 as well.
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.robustness.chaos import nan_gradient_fobj
+from lightgbm_tpu.robustness.checkpoint import CheckpointError
+from lightgbm_tpu.robustness.numeric import NonFiniteError
+from lightgbm_tpu.utils.log import LightGBMError
+
+pytestmark = pytest.mark.chaos
+
+
+def _data(n=600, f=6, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 3) + 0.1 * rng.randn(n)).astype(
+        np.float64)
+    return X, y
+
+
+# bagging on purpose: resume must restore the RNG key and carried bag mask
+# exactly, or the continued run diverges immediately
+BASE = dict(objective="regression", num_leaves=15, learning_rate=0.1,
+            min_data_in_leaf=5, verbose=-1, metric="none", seed=17,
+            bagging_fraction=0.8, bagging_freq=1)
+
+
+# ------------------------------------------------------------ kill-and-resume
+
+@pytest.mark.parametrize("tree_learner", ["serial", "data"])
+def test_kill_and_resume_bit_identical(tmp_path, tree_learner):
+    """Training killed between checkpoints, restarted with the identical
+    command (resume_from=auto), must produce bit-identical model text to an
+    uninterrupted run — on both the serial and the virtual-device
+    data-parallel path."""
+    X, y = _data()
+    params = dict(BASE, tree_learner=tree_learner)
+    straight = lgb.train(params, lgb.Dataset(X, label=y),
+                         num_boost_round=8).model_to_string()
+
+    ck = dict(params, checkpoint_dir=str(tmp_path), checkpoint_interval=2)
+    # "kill" at iteration 5: the run stops after 5 iterations, so the last
+    # snapshot on disk is the interval-2 checkpoint at iteration 4 — resume
+    # discards iteration 5's tree and replays from 4
+    lgb.train(ck, lgb.Dataset(X, label=y), num_boost_round=5)
+    resumed = lgb.train(ck, lgb.Dataset(X, label=y), num_boost_round=8,
+                        resume_from="auto")
+    assert resumed.num_trees() == 8
+    assert resumed.model_to_string() == straight
+
+
+def test_resume_from_auto_starts_fresh_without_checkpoints(tmp_path):
+    X, y = _data(n=300)
+    ck = dict(BASE, checkpoint_dir=str(tmp_path / "empty"))
+    bst = lgb.train(ck, lgb.Dataset(X, label=y), num_boost_round=3,
+                    resume_from="auto")
+    assert bst.num_trees() == 3
+
+
+def test_resume_rejects_different_dataset_of_same_shape(tmp_path):
+    """The config fingerprint excludes data PATHS, so a resume pointed at a
+    shape-compatible but different dataset must be caught by the dataset
+    fingerprint instead of silently corrupting the model."""
+    X, y = _data(n=300)
+    ck = dict(BASE, checkpoint_dir=str(tmp_path), checkpoint_interval=2)
+    lgb.train(ck, lgb.Dataset(X, label=y), num_boost_round=2)
+    X2, y2 = _data(n=300, seed=99)           # same shape, different rows
+    with pytest.raises(LightGBMError, match="dataset mismatch"):
+        lgb.train(ck, lgb.Dataset(X2, label=y2), num_boost_round=4,
+                  resume_from="auto")
+
+
+def test_dart_rejects_checkpoint_config():
+    """dart + checkpoint knobs must fail at config time — not 10 iterations
+    in, when the interval callback hits the save-time check."""
+    with pytest.raises(LightGBMError, match="dart"):
+        lgb.Config.from_params(dict(boosting="dart", checkpoint_dir="/ck"))
+    with pytest.raises(LightGBMError, match="dart"):
+        lgb.Config.from_params(dict(boosting="dart", resume_from="auto"))
+
+
+def test_resume_rejects_semantic_config_change(tmp_path):
+    """A resumed run whose training semantics differ must fail loudly,
+    naming the mismatched fields — silently mixing forests grown under
+    different configs is the corruption this check exists to catch."""
+    X, y = _data(n=300)
+    ck = dict(BASE, checkpoint_dir=str(tmp_path), checkpoint_interval=2)
+    lgb.train(ck, lgb.Dataset(X, label=y), num_boost_round=2)
+    with pytest.raises(CheckpointError, match="num_leaves"):
+        lgb.train(dict(ck, num_leaves=31), lgb.Dataset(X, label=y),
+                  num_boost_round=4, resume_from="auto")
+
+
+# ------------------------------------------------------- nan_policy branches
+
+def _nan_params(policy, **extra):
+    # objective="none" routes the chaos fobj's poisoned gradients into the
+    # custom step; boost_from_average off keeps preds = raw scores
+    return dict(objective="none", verbose=-1, metric="none",
+                boost_from_average=False, nan_policy=policy, **extra)
+
+
+def test_nan_policy_raise_fails_loudly_with_clean_state():
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    fobj = nan_gradient_fobj(bad_iters=[2])
+    with pytest.raises(NonFiniteError, match="gradients"):
+        lgb.train(_nan_params("raise"), ds, num_boost_round=6, fobj=fobj)
+
+
+def test_nan_policy_skip_iter_drops_poisoned_iterations(caplog):
+    X, y = _data()
+    fobj = nan_gradient_fobj(bad_iters=[1, 3], mode="inf")
+    with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+        bst = lgb.train(_nan_params("skip_iter"), lgb.Dataset(X, label=y),
+                        num_boost_round=6, fobj=fobj)
+    assert bst.num_trees() == 4            # 6 rounds - 2 dropped iterations
+    assert np.isfinite(bst.predict(X)).all()
+    skips = [r for r in caplog.records
+             if "skip_iter: dropped iteration" in r.getMessage()]
+    assert len(skips) == 2
+
+
+def test_nan_policy_skip_iter_aborts_on_deterministic_poison():
+    X, y = _data(n=300)
+    fobj = nan_gradient_fobj(bad_iters=range(100))     # every iteration bad
+    with pytest.raises(NonFiniteError, match="consecutive"):
+        lgb.train(_nan_params("skip_iter"), lgb.Dataset(X, label=y),
+                  num_boost_round=30, fobj=fobj)
+
+
+def test_nan_policy_clip_sanitizes_and_continues(caplog):
+    X, y = _data()
+    fobj = nan_gradient_fobj(bad_iters=[1], frac=0.02)
+    with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+        bst = lgb.train(_nan_params("clip"), lgb.Dataset(X, label=y),
+                        num_boost_round=6, fobj=fobj)
+    assert bst.num_trees() == 6            # nothing dropped
+    assert np.isfinite(bst.predict(X)).all()
+    assert any("nan_policy=clip" in r.getMessage() for r in caplog.records)
+
+
+def test_nan_policy_none_is_the_default_and_unguarded():
+    X, y = _data(n=300)
+    bst = lgb.train(dict(BASE), lgb.Dataset(X, label=y), num_boost_round=2,
+                    keep_training_booster=True)
+    assert bst._gbdt.nan_policy == "none"
+
+
+def test_dart_rejects_gated_policies():
+    X, y = _data(n=300)
+    with pytest.raises(LightGBMError, match="dart"):
+        lgb.train(dict(BASE, boosting="dart", nan_policy="skip_iter"),
+                  lgb.Dataset(X, label=y), num_boost_round=2)
+
+
+def test_dart_rejects_checkpointing(tmp_path):
+    X, y = _data(n=300)
+    params = dict(BASE, boosting="dart")
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2,
+                    keep_training_booster=True)
+    with pytest.raises(LightGBMError, match="dart"):
+        bst.save_checkpoint(str(tmp_path))

@@ -1,0 +1,387 @@
+"""Unit tests for the fault-tolerance layer (lightgbm_tpu/robustness/):
+retry/backoff, the atomic checkpoint store + config fingerprint, the
+resilient host_allgather over the chaos KV clients, machine-list
+validation, and the retried jax.distributed.initialize wiring.
+See docs/Fault-Tolerance.md.
+"""
+import logging
+import os
+import pickle
+import random
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel import comm
+from lightgbm_tpu.robustness import allowed_host_sync
+from lightgbm_tpu.robustness.chaos import (ChaosKVClient, ChaosPlan,
+                                           FakeKVStore, corrupt_payload,
+                                           install_kv_chaos,
+                                           uninstall_kv_chaos)
+from lightgbm_tpu.robustness.checkpoint import (CheckpointError,
+                                                CheckpointManager,
+                                                config_fingerprint,
+                                                config_mismatch_fields,
+                                                fingerprinted_config)
+from lightgbm_tpu.robustness.retry import (CommRetryError, CommTimeoutError,
+                                           retry_call)
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    """Keep every retried test sub-second and log-visible."""
+    monkeypatch.setenv("LGBM_TPU_COMM_BACKOFF_BASE", "0.001")
+    monkeypatch.setenv("LGBM_TPU_COMM_BACKOFF_MAX", "0.01")
+    monkeypatch.setenv("LGBM_TPU_COMM_RETRIES", "3")
+    logging.getLogger("lightgbm_tpu").setLevel(logging.DEBUG)
+
+
+# ---------------------------------------------------------------- retry_call
+
+def test_retry_succeeds_after_transient_failures(caplog):
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TimeoutError("transient")
+        return "ok"
+
+    with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+        out = retry_call(flaky, what="unit-op", sleep=lambda d: None)
+    assert out == "ok" and len(calls) == 3
+    retried = [r for r in caplog.records if "retrying" in r.getMessage()]
+    assert len(retried) == 2
+    assert "unit-op" in retried[0].getMessage()
+
+
+def test_retry_exhaustion_names_the_operation():
+    with pytest.raises(CommRetryError, match="doomed-op.*3 attempt"):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("down")),
+                   what="doomed-op", sleep=lambda d: None)
+
+
+def test_backoff_schedule_doubles_and_caps():
+    delays = []
+    with pytest.raises(CommRetryError):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("x")),
+                   what="sched", attempts=5, base_delay=1.0, max_delay=4.0,
+                   jitter=0.0, sleep=delays.append,
+                   rng=random.Random(0))
+    assert delays == [1.0, 2.0, 4.0, 4.0]    # 2**k, then the ceiling
+
+
+def test_backoff_jitter_is_bounded_and_seeded():
+    def run():
+        delays = []
+        with pytest.raises(CommRetryError):
+            retry_call(lambda: (_ for _ in ()).throw(OSError("x")),
+                       what="jit", attempts=3, base_delay=1.0, max_delay=10.0,
+                       jitter=0.5, sleep=delays.append,
+                       rng=random.Random(7))
+        return delays
+
+    d1, d2 = run(), run()
+    assert d1 == d2                           # seeded = reproducible
+    assert 1.0 <= d1[0] <= 1.5 and 2.0 <= d1[1] <= 3.0
+
+
+def test_env_knobs_are_read_at_call_time(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_COMM_RETRIES", "5")
+    calls = []
+    with pytest.raises(CommRetryError):
+        retry_call(lambda: calls.append(1) or (_ for _ in ()).throw(
+            OSError("x")), what="env", sleep=lambda d: None)
+    assert len(calls) == 5
+
+
+# ------------------------------------------------------------- checkpoints
+
+def _payload(i=0):
+    return {"config_fingerprint": "fp", "config": {}, "iteration": i,
+            "state": {"iter": i}}
+
+
+def test_checkpoint_ids_are_monotonic_and_resume_counting(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=0)
+    p1 = mgr.save(_payload(1))
+    p2 = mgr.save(_payload(2))
+    assert os.path.basename(p1) == "ckpt_0000000001.pkl"
+    assert os.path.basename(p2) == "ckpt_0000000002.pkl"
+    # a fresh manager (the resumed process) keeps counting
+    p3 = CheckpointManager(str(tmp_path)).save(_payload(3))
+    assert os.path.basename(p3) == "ckpt_0000000003.pkl"
+    assert mgr.latest() == p3
+
+
+def test_keep_last_n_prunes_old_snapshots(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+    for i in range(5):
+        mgr.save(_payload(i))
+    ids = [i for i, _ in mgr.list_checkpoints()]
+    assert ids == [4, 5]
+
+
+def test_save_sweeps_orphaned_tmp_files(tmp_path):
+    orphan = tmp_path / "ckpt_0000000009.pkl.tmp.12345"
+    orphan.write_bytes(b"half-written")
+    CheckpointManager(str(tmp_path)).save(_payload())
+    assert not orphan.exists()
+
+
+def test_truncated_snapshot_fails_loudly(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    path = mgr.save(_payload())
+    raw = open(path, "rb").read()
+    with open(path, "wb") as fh:               # simulate a torn write that
+        fh.write(raw[: len(raw) // 2])         # somehow survived (bit rot)
+    with pytest.raises(CheckpointError, match="corrupt or truncated"):
+        CheckpointManager.load(path)
+
+
+def test_non_checkpoint_and_missing_fields_rejected(tmp_path):
+    p = tmp_path / "ckpt_0000000001.pkl"
+    p.write_bytes(pickle.dumps({"something": "else"}))
+    with pytest.raises(CheckpointError, match="format_version"):
+        CheckpointManager.load(str(p))
+    p.write_bytes(pickle.dumps({"format_version": 1, "config": {},
+                                "config_fingerprint": "x", "state": {}}))
+    with pytest.raises(CheckpointError, match="iteration"):
+        CheckpointManager.load(str(p))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(CheckpointError, match="no checkpoints"):
+        CheckpointManager.resolve(str(empty))
+    with pytest.raises(CheckpointError, match="does not exist"):
+        CheckpointManager.resolve(str(tmp_path / "missing.pkl"))
+
+
+def test_fingerprint_ignores_run_control_but_not_semantics():
+    base = Config.from_params(dict(objective="binary", num_leaves=15))
+    fp = config_fingerprint(base)
+    # volatile: paths, num_iterations, checkpoint knobs, cluster wiring
+    same = base.replace(num_iterations=999, output_model="elsewhere.txt",
+                        checkpoint_dir="/ck", machines="a:1,b:2")
+    assert config_fingerprint(same) == fp
+    # semantic: num_leaves/seed/objective must change the fingerprint
+    assert config_fingerprint(base.replace(num_leaves=31)) != fp
+    assert config_fingerprint(base.replace(seed=9)) != fp
+    diff = config_mismatch_fields(fingerprinted_config(base),
+                                  base.replace(num_leaves=31, seed=9))
+    assert diff == ["num_leaves", "seed"]
+
+
+# ------------------------------------------------- host_allgather resilience
+
+def _gather_key(tag):
+    """The KV key prefix host_allgather will use for its NEXT call."""
+    return f"lgbm_hostgather/{tag}/{comm._host_allgather_seq[0]}"
+
+
+def _store_with_peer(tag, peer_obj, world=2, **kw):
+    store = FakeKVStore(**kw)
+    store.preload(f"{_gather_key(tag)}/1", pickle.dumps(peer_obj))
+    return store
+
+
+def test_host_allgather_happy_path_deletes_own_key_after_barrier():
+    key = _gather_key("t0")
+    store = _store_with_peer("t0", {"rank": 1})
+    out = comm.host_allgather({"rank": 0}, "t0", timeout_ms=500,
+                              client=store, rank=0, world=2)
+    assert out == [{"rank": 0}, {"rank": 1}]
+    assert store.barrier_waits == [f"{key}/done"]
+    assert store.deleted == [f"{key}/0"]
+
+
+def test_host_allgather_failed_barrier_logs_and_keeps_key(caplog):
+    key = _gather_key("t1")
+    store = _store_with_peer("t1", 42, barrier_fails=True)
+    with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+        out = comm.host_allgather(41, "t1", timeout_ms=500,
+                                  client=store, rank=0, world=2)
+    assert out == [41, 42]
+    assert store.deleted == []                 # key left for TTL expiry
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("cleanup barrier failed" in m and "t1" in m and "rank=0" in m
+               for m in msgs)
+    assert f"{key}/0" in store.data            # still present
+
+
+def test_host_allgather_splits_timeout_budget_across_attempts(monkeypatch):
+    """timeout_ms is a TOTAL per-peer budget: a dead peer must cost about
+    timeout_ms, not attempts x timeout_ms."""
+    monkeypatch.setenv("LGBM_TPU_COMM_RETRIES", "4")
+    seen = []
+
+    class Probe(FakeKVStore):
+        def blocking_key_value_get_bytes(self, key, timeout_ms):
+            seen.append(timeout_ms)
+            return super().blocking_key_value_get_bytes(key, timeout_ms)
+
+    store = Probe()
+    store.preload(f"{_gather_key('t7')}/1", pickle.dumps("peer"))
+    out = comm.host_allgather("mine", "t7", timeout_ms=1000,
+                              client=store, rank=0, world=2)
+    assert out == ["mine", "peer"]
+    assert seen == [250]                      # 1000 ms / 4 attempts
+
+
+def test_host_allgather_set_is_idempotent_on_retry():
+    """A set whose first attempt landed server-side but lost its ack must
+    overwrite (identical payload) on retry, not die on ALREADY_EXISTS —
+    FakeKVStore mimics the real client's allow_overwrite=False default."""
+    key = _gather_key("t6")
+    store = _store_with_peer("t6", "peer")
+    store.preload(f"{key}/0", pickle.dumps("stale-first-attempt"))
+    out = comm.host_allgather("mine", "t6", timeout_ms=500,
+                              client=store, rank=0, world=2)
+    assert out == ["mine", "peer"]
+
+
+@pytest.mark.chaos
+def test_injected_drop_and_delay_trigger_retry_with_backoff(caplog):
+    store = _store_with_peer("t2", "peer-shard")
+    chaos = ChaosKVClient(store, ChaosPlan(seed=1234, drop_gets=(0,),
+                                           delay_gets=(1,),
+                                           delay_seconds=0.001))
+    with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+        out = comm.host_allgather("mine", "t2", timeout_ms=500,
+                                  client=chaos, rank=0, world=2)
+    assert out == ["mine", "peer-shard"]
+    faults = [(f, op) for f, op, _k in chaos.events]
+    assert ("drop", "get") in faults and ("delay", "get") in faults
+    retried = [r for r in caplog.records if "retrying in" in r.getMessage()]
+    assert retried and "t2" in retried[0].getMessage()
+
+
+@pytest.mark.chaos
+def test_injected_corruption_refetches_cleanly(caplog):
+    store = _store_with_peer("t3", {"x": np.arange(4)})
+    chaos = ChaosKVClient(store, ChaosPlan(seed=7, corrupt_gets=(0,)))
+    with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+        out = comm.host_allgather("mine", "t3", timeout_ms=500,
+                                  client=chaos, rank=0, world=2)
+    assert np.array_equal(out[1]["x"], np.arange(4))
+    assert ("corrupt", "get") in [(f, op) for f, op, _k in chaos.events]
+    assert any("retrying in" in r.getMessage() for r in caplog.records)
+
+
+@pytest.mark.chaos
+def test_exhausted_retries_raise_timeout_naming_tag_and_ranks():
+    store = _store_with_peer("t4", "peer")
+    chaos = ChaosKVClient(store, ChaosPlan(seed=1, drop_gets=(0, 1, 2)))
+    with pytest.raises(CommTimeoutError, match=r"'t4'.*rank 0.*rank 1"):
+        comm.host_allgather("mine", "t4", timeout_ms=500,
+                            client=chaos, rank=0, world=2)
+
+
+@pytest.mark.chaos
+def test_install_kv_chaos_wraps_without_touching_call_sites(caplog):
+    store = _store_with_peer("t5", "peer")
+    wrapper = install_kv_chaos(ChaosPlan(seed=3, drop_gets=(0,)))
+    try:
+        with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+            out = comm.host_allgather("mine", "t5", timeout_ms=500,
+                                      client=store, rank=0, world=2)
+        assert out == ["mine", "peer"]
+        (chaos_client,) = wrapper.clients.values()
+        assert ("drop", "get") in [(f, op) for f, op, _k in
+                                   chaos_client.events]
+    finally:
+        uninstall_kv_chaos()
+    assert comm._client_wrapper is None
+
+
+def test_corrupt_payload_breaks_unpickling_deterministically():
+    raw = pickle.dumps({"a": list(range(50))})
+    bad1, bad2 = corrupt_payload(raw, seed=5), corrupt_payload(raw, seed=5)
+    assert bad1 == bad2 and bad1 != raw
+    with pytest.raises(Exception):
+        pickle.loads(bad1)
+
+
+# ------------------------------------------------------- machine list / init
+
+def test_parse_machine_list_valid_forms():
+    cfg = Config.from_params(dict(
+        machines="10.0.0.1:12400,10.0.0.2 12401\nhost-3:80"))
+    assert comm.parse_machine_list(cfg) == [
+        ("10.0.0.1", 12400), ("10.0.0.2", 12401), ("host-3", 80)]
+
+
+@pytest.mark.parametrize("entry", [
+    "justahost",          # no port at all
+    "host:",              # empty port
+    "host:notaport",      # junk port
+    ":12400",             # empty host
+    "host:0",             # port out of range
+    "host:70000",         # port out of range
+    "a:b:c",              # too many colons
+])
+def test_parse_machine_list_malformed_entries_are_named(entry):
+    cfg = Config.from_params(dict(machines=f"10.0.0.1:12400,{entry}"))
+    with pytest.raises(ValueError) as ei:
+        comm.parse_machine_list(cfg)
+    assert entry in str(ei.value) and "host:port" in str(ei.value)
+
+
+def test_init_distributed_retries_the_coordination_handshake(monkeypatch):
+    import jax
+    attempts = []
+
+    def flaky_initialize(**kw):
+        attempts.append(kw)
+        if len(attempts) == 1:
+            raise RuntimeError("coordination service not up yet")
+
+    monkeypatch.setattr(comm, "distributed_client", lambda: None)
+    monkeypatch.setattr(jax.distributed, "initialize", flaky_initialize)
+    cfg = Config.from_params(dict(
+        num_machines=2, machines="127.0.0.1:12400,127.0.0.1:12401",
+        local_listen_port=12400, time_out=1))
+    comm.init_distributed(cfg)
+    assert len(attempts) == 2                  # failed once, then joined
+    assert attempts[0]["process_id"] == 0
+    assert attempts[0]["coordinator_address"] == "127.0.0.1:12400"
+
+
+def test_init_distributed_exhaustion_names_rank_and_coordinator(monkeypatch):
+    import jax
+
+    def always_down(**kw):
+        raise RuntimeError("ECONNREFUSED")
+
+    monkeypatch.setattr(comm, "distributed_client", lambda: None)
+    monkeypatch.setattr(jax.distributed, "initialize", always_down)
+    cfg = Config.from_params(dict(
+        num_machines=2, machines="127.0.0.1:12400,127.0.0.1:12401",
+        local_listen_port=12401, time_out=1))
+    with pytest.raises(CommTimeoutError, match="rank 1.*127.0.0.1:12400"):
+        comm.init_distributed(cfg)
+
+
+# ----------------------------------------------------------- misc contracts
+
+def test_allowed_host_sync_requires_a_reason():
+    with pytest.raises(ValueError):
+        allowed_host_sync("")
+
+    @allowed_host_sync("documented contract")
+    def fn():
+        return 1
+
+    assert fn() == 1
+    assert fn.__host_sync_reason__ == "documented contract"
+
+
+def test_config_rejects_bad_robustness_params():
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(nan_policy="explode"))
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(checkpoint_interval=5))   # no checkpoint_dir
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(checkpoint_keep_last_n=-1))

@@ -44,6 +44,16 @@ def test_clean_fixture_has_no_findings():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_allowed_host_sync_waives_r002():
+    """The robustness.allowed_host_sync decorator (bare or dotted) marks an
+    audited sync point — R002 must skip the function entirely, while the
+    undecorated twin fixture in the same hot-path dir still fires."""
+    findings, err = lint_file(
+        os.path.join(FIXDIR, "lightgbm_tpu", "ops", "waived_r002.py"))
+    assert err is None
+    assert findings == [], [f.format() for f in findings]
+
+
 @pytest.mark.parametrize("relpath,rule", BAD_FIXTURES)
 def test_cli_exits_nonzero_on_each_fixture(relpath, rule):
     out = subprocess.run(
