@@ -81,6 +81,51 @@ def _round_ratio(x: float) -> float:
 _PARTIAL = {}
 
 
+def _timed_update_phase(name, bst, warmup, timed, timings, tree_batch=1):
+    """Warm up + time one booster's training loop with the attributable
+    per-phase breakdown (utils/timer.PhaseBreakdown): compile/warm-up
+    wall-clock vs steady-state wall-clock vs host-sync + recompile counts
+    from a record-only RecompileGuard. The breakdown lands in
+    ``timings[name]`` (emitted as ``phase_timings`` in the BENCH json).
+
+    ``warmup``/``timed`` are ITERATION counts. With ``tree_batch``>1 the
+    loop drives fused batches (gbdt.train_batch) instead of per-tree
+    updates — warm-up is at least 2 batches (first-dispatch compile + the
+    committed-sharding steady variant) and the timed window is rounded to
+    whole batches. Returns (steady_elapsed_s, guard, timed_iters_actual)."""
+    from lightgbm_tpu.analysis.guards import RecompileGuard
+    from lightgbm_tpu.utils.timer import PhaseBreakdown
+    g = bst._gbdt
+    tb = max(1, tree_batch)
+    if tb > 1:
+        warm_steps = max(2, (warmup + tb - 1) // tb)
+        timed_steps = max(1, timed // tb)
+        iters = timed_steps * tb
+        step = lambda: g.train_batch(tb)              # noqa: E731
+    else:
+        warm_steps, timed_steps, iters = warmup, timed, timed
+        step = bst.update
+    pb = PhaseBreakdown(name)
+    with pb.compile_window():
+        for _ in range(warm_steps):
+            step()
+        np.asarray(g.score).sum()             # drain queued warm-up work
+    guard = RecompileGuard(label=name, fail=False)
+    guard.register(g._step_fn if tb == 1 else g._batch_step_fns.get(tb),
+                   "train_step")
+    with guard:
+        guard.mark_warm()
+        with pb.steady_window(iters):
+            t0 = time.perf_counter()
+            for _ in range(timed_steps):
+                step()
+            np.asarray(g.score).sum()           # the one intended host sync
+            elapsed = time.perf_counter() - t0
+    pb.attach_guard(guard.report())
+    timings[name] = pb.to_dict()
+    return elapsed, guard, iters
+
+
 def _probe_backend(retries=1, delay=10.0, timeout=90):
     """Probe the backend in a subprocess (a wedged tunnel can hang any jax
     call in-process forever; a child process is always killable)."""
@@ -183,8 +228,9 @@ def run_sparse_phase():
     if _FORCE_CPU:
         from lightgbm_tpu.utils.hermetic import force_cpu_backend
         force_cpu_backend()
-    from lightgbm_tpu.utils.cache import enable_compile_cache, repo_cache_dir
-    enable_compile_cache(repo_cache_dir())
+    from lightgbm_tpu.utils.cache import (maybe_enable_compile_cache,
+                                          repo_cache_dir)
+    maybe_enable_compile_cache(repo_cache_dir())
     import jax
     import lightgbm_tpu as lgb
 
@@ -269,9 +315,14 @@ def run_bench(deadline, attempt=0, platform=None):
         platform = _probe_backend()
 
     # persistent compile cache: remote TPU compiles of the train step take
-    # minutes through the tunnel; a warm cache keeps them out of the budget
-    from lightgbm_tpu.utils.cache import enable_compile_cache, repo_cache_dir
-    enable_compile_cache(repo_cache_dir())
+    # minutes through the tunnel; a warm cache keeps them out of the budget.
+    # LGBM_TPU_COMPILE_CACHE_DIR overrides the repo-local default; the
+    # resolved dir is exported so every subprocess phase (sparse, CPU
+    # fallback) hits the SAME cache instead of burning its timeout slice
+    # on recompiles.
+    from lightgbm_tpu.utils.cache import (maybe_enable_compile_cache,
+                                          repo_cache_dir)
+    compile_cache_dir = maybe_enable_compile_cache(repo_cache_dir())
 
     import lightgbm_tpu as lgb
 
@@ -325,14 +376,24 @@ def run_bench(deadline, attempt=0, platform=None):
     Xt, yt = X_all[n_rows:], y_all[n_rows:]
     X, y = X_all[:n_rows], y_all[:n_rows]
 
+    # fused multi-tree steps (tree_batch, boosting/gbdt.py): the headline
+    # runs K iterations per jit dispatch — the dispatch-overhead fix this
+    # bench exists to measure. LGBM_TPU_BENCH_TREE_BATCH=1 restores the
+    # per-tree dispatch for A/B comparison.
+    tree_batch = int(os.environ.get("LGBM_TPU_BENCH_TREE_BATCH", "4"))
     params = dict(
         objective="binary", num_leaves=255, max_bin=255, learning_rate=0.1,
         min_data_in_leaf=100, verbose=-1, metric="none",
-        tpu_hist_kernel=kernel,
+        tpu_hist_kernel=kernel, tree_batch=tree_batch,
     )
     slots = int(os.environ.get("LGBM_TPU_BENCH_SLOTS", "0"))
     if slots:
         params["tpu_hist_slots"] = slots
+
+    # attributable per-phase timing (utils/timer.PhaseBreakdown): every
+    # timed phase records compile_s / steady_s / host_syncs / recompiles
+    # here; emitted as "phase_timings" in the JSON (docs/TPU-Performance.md)
+    timings = {}
 
     # ---- quick-scale pre-bank (VERDICT r4 #1) -----------------------------
     # Bank a 2.1M-row headline into _PARTIAL BEFORE the expensive full-scale
@@ -358,15 +419,10 @@ def run_bench(deadline, attempt=0, platform=None):
                 dq.save_binary(qbin + ".tmp")
                 os.replace(qbin + ".tmp", qbin)
             bq = lgb.Booster(params=params, train_set=dq)
-            for _ in range(2):
-                bq.update()
-            np.asarray(bq._gbdt.score).sum()
-            t0 = time.perf_counter()
-            q_timed = 5
-            for _ in range(q_timed):
-                bq.update()
-            np.asarray(bq._gbdt.score).sum()
-            elq = time.perf_counter() - t0
+            # same fused dispatch path as the headline — the pre-banked
+            # number must measure the same thing it stands in for
+            elq, _, q_timed = _timed_update_phase(
+                "quick", bq, 2, 8, timings, tree_batch=bq._gbdt.tree_batch)
             tq = quick_rows * q_timed / elq / 1e6
             _PARTIAL["result"] = {
                 "metric": "higgs_train_throughput",
@@ -377,6 +433,7 @@ def run_bench(deadline, attempt=0, platform=None):
                 "rows": quick_rows,
                 "kernel": bq._gbdt.spec.hist_kernel,
                 "attempt": attempt,
+                "phase_timings": timings,
                 "note": ("quick-scale pre-bank; the full-scale phase did "
                          "not complete"),
             }
@@ -409,22 +466,14 @@ def run_bench(deadline, attempt=0, platform=None):
     # reduced-scale run fits its budget slice even on a contended host
     timed = int(os.environ.get("LGBM_TPU_BENCH_TIMED_ITERS", "12"))
     warmup = 3 if timed >= 12 else 2
-    for _ in range(warmup):
-        bst.update()
-    # force all queued work to finish before starting the clock
-    np.asarray(bst._gbdt.score).sum()
-    # record-only recompile guard (fail=False: a recompile here is reported
-    # in the JSON, not a crash — `bench.py --smoke` is the enforcing run)
-    from lightgbm_tpu.analysis.guards import RecompileGuard
-    guard = RecompileGuard(label="bench", fail=False)
-    guard.register(bst._gbdt._step_fn, "train_step")
-    with guard:
-        guard.mark_warm()
-        t0 = time.perf_counter()
-        for _ in range(timed):
-            bst.update()
-        np.asarray(bst._gbdt.score).sum()
-        elapsed = time.perf_counter() - t0
+    # warm-up + timed loop under the per-phase breakdown and a record-only
+    # recompile guard (fail=False: a recompile here is reported in the
+    # JSON, not a crash — `bench.py --smoke` is the enforcing run). The
+    # headline drives the FUSED multi-tree path (tree_batch, the dispatch-
+    # overhead tentpole): K iterations per jit dispatch.
+    elapsed, guard, timed = _timed_update_phase(
+        "headline", bst, warmup, timed, timings,
+        tree_batch=bst._gbdt.tree_batch)
     mrow_tree_per_s = n_rows * timed / elapsed / 1e6
 
     result = {
@@ -437,7 +486,9 @@ def run_bench(deadline, attempt=0, platform=None):
         "kernel": kernel_resolved,
         "attempt": attempt,
         **({"hist_slots": slots} if slots else {}),
+        "tree_batch": bst._gbdt.tree_batch,
         "recompiles_post_warmup": guard.report()["post_warmup_cache_misses"],
+        "phase_timings": timings,
         "auc": None,
         "auc_parity_gap": None,
     }
@@ -458,9 +509,9 @@ def run_bench(deadline, attempt=0, platform=None):
 
     # ---- AUC on held-out rows (quality alongside every perf claim) --------
     if deadline() > 60:
+        result["iters_for_auc"] = len(bst._gbdt.models)
         bst._finalize()
         result["auc"] = round(_auc(yt, bst.predict(Xt)), 6)
-        result["iters_for_auc"] = warmup + timed
 
     # Optional phases below must never void the headline result — a failure
     # or timeout there is recorded, not propagated.
@@ -484,15 +535,8 @@ def run_bench(deadline, attempt=0, platform=None):
                 metric="none", tpu_hist_kernel=kernel)
             dsr = lgb.Dataset(Xr[:n_tr], label=yr[:n_tr], group=gr[:n_tr_q])
             br = lgb.Booster(params=rank_params, train_set=dsr)
-            for _ in range(2):
-                br.update()
-            np.asarray(br._gbdt.score).sum()
-            t0 = time.perf_counter()
-            rank_timed = 6
-            for _ in range(rank_timed):
-                br.update()
-            np.asarray(br._gbdt.score).sum()
-            elr = time.perf_counter() - t0
+            elr, _, rank_timed = _timed_update_phase("ranking", br, 2, 6,
+                                                     timings)
             rank_tp = n_tr * rank_timed / elr / 1e6
             result["ranking_mrow_tree_per_s"] = _round_tp(rank_tp)
             result["ranking_vs_baseline"] = _round_ratio(
@@ -558,16 +602,13 @@ def run_bench(deadline, attempt=0, platform=None):
                 ds63.save_binary(bin63 + ".tmp")
                 os.replace(bin63 + ".tmp", bin63)
             b63 = lgb.Booster(params=dict(params, max_bin=63), train_set=ds63)
-            for _ in range(2):
-                b63.update()
-            np.asarray(b63._gbdt.score).sum()
-            t0 = time.perf_counter()
-            for _ in range(8):
-                b63.update()
-            np.asarray(b63._gbdt.score).sum()
-            el63 = time.perf_counter() - t0
+            # same dispatch mode as the headline: the 63-bin comparison must
+            # isolate bin width, not re-add the per-tree dispatch overhead
+            el63, _, it63 = _timed_update_phase(
+                "gpu_config", b63, 2, 8, timings,
+                tree_batch=b63._gbdt.tree_batch)
             result["gpu_config_mrow_tree_per_s"] = _round_tp(
-                n_rows * 8 / el63 / 1e6)
+                n_rows * it63 / el63 / 1e6)
             del b63, ds63
     except BenchTimeout:
         raise
@@ -581,10 +622,16 @@ def run_bench(deadline, attempt=0, platform=None):
                 and os.environ.get("LGBM_TPU_BENCH_SPARSE", "1") != "0"):
             # reserve ~210s so the wave-vs-exact parity gate (deadline > 150)
             # still runs after this phase
+            sp_env = dict(os.environ)
+            if compile_cache_dir:
+                # the subprocess phase inherits the compile cache dir so its
+                # timeout slice is not burned on recompiles of kernels this
+                # process (or a previous run) already compiled
+                sp_env["LGBM_TPU_COMPILE_CACHE_DIR"] = compile_cache_dir
             sp_out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--sparse"],
                 timeout=int(min(deadline() - 210, 1500)),
-                capture_output=True, text=True)
+                capture_output=True, text=True, env=sp_env)
             if sp_out.returncode == 0 and sp_out.stdout.strip():
                 result.update(
                     json.loads(sp_out.stdout.strip().splitlines()[-1]))
@@ -795,8 +842,17 @@ def main():
             errors.append(f"cpu fallback skipped: only {remain}s left")
         else:
             try:
+                from lightgbm_tpu.utils.cache import repo_cache_dir
+                # optional-phase subprocesses inherit the compile cache dir
+                # (cold recompiles ate the fallback's budget slice
+                # otherwise) — but an explicit disable ("", "0", "off")
+                # must pass through, not be overridden by the default
+                _cache_env = os.environ.get("LGBM_TPU_COMPILE_CACHE_DIR")
+                if _cache_env is None:
+                    _cache_env = repo_cache_dir()
                 env = dict(os.environ,
                            LGBM_TPU_BENCH_PLATFORM="cpu",
+                           LGBM_TPU_COMPILE_CACHE_DIR=_cache_env,
                            LGBM_TPU_BENCH_KERNEL="xla",
                            LGBM_TPU_BENCH_ROWS="50000",
                            LGBM_TPU_BENCH_TIMED_ITERS="4",
@@ -846,7 +902,11 @@ def run_smoke():
     them. Also asserts a checkpoint save/resume round trip
     (docs/Fault-Tolerance.md) stays recompile-free: a mid-loop
     save_checkpoint and a full resume into a fresh booster must both keep
-    hitting the warm executable. Prints one JSON line; exit 0 iff the
+    hitting the warm executable. Additionally asserts the persistent XLA
+    compile cache round-trips: a child training run populates a fresh
+    cache dir, and an identical second run compiles nothing (writes no new
+    cache entries) — the cache-hit path that keeps repeated remote-TPU
+    compiles out of bench budgets. Prints one JSON line; exit 0 iff the
     guards hold."""
     from lightgbm_tpu.utils.hermetic import force_cpu_backend
     force_cpu_backend()
@@ -910,17 +970,70 @@ def run_smoke():
     finally:
         shutil.rmtree(ck_dir, ignore_errors=True)
 
+    # ---- persistent compile cache round trip -------------------------------
+    # Two identical micro-training children against a fresh cache dir: the
+    # first must POPULATE it, the second must be a pure cache hit (no new
+    # entries written = nothing compiled). This is the property that lets
+    # bench phases inherit a warm LGBM_TPU_COMPILE_CACHE_DIR instead of
+    # burning their subprocess timeouts on recompiles.
+    cache_ok, cache_err = True, None
+    cache_dir = tempfile.mkdtemp(prefix="lgbm_smoke_jaxcache_")
+    child_code = (
+        "from lightgbm_tpu.utils.hermetic import force_cpu_backend;"
+        "force_cpu_backend();"
+        "import os, numpy as np;"
+        "from lightgbm_tpu.utils.cache import enable_compile_cache;"
+        "enable_compile_cache(os.environ['LGBM_TPU_COMPILE_CACHE_DIR'],"
+        "                     min_compile_secs=0.0);"
+        "import lightgbm_tpu as lgb;"
+        "rng = np.random.RandomState(0);"
+        "X = rng.rand(512, 8).astype(np.float32);"
+        "y = (X[:, 0] > 0.5).astype(np.float32);"
+        "p = dict(objective='binary', num_leaves=7, max_bin=15,"
+        "         min_data_in_leaf=5, verbose=-1, metric='none');"
+        "lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=2)"
+    )
+    try:
+        cache_env = dict(os.environ, LGBM_TPU_COMPILE_CACHE_DIR=cache_dir)
+        first_entries = None
+        for attempt in range(2):
+            r = subprocess.run([sys.executable, "-c", child_code],
+                               env=cache_env, capture_output=True, text=True,
+                               timeout=300)
+            if r.returncode != 0:
+                raise RuntimeError(f"cache child run {attempt} failed: "
+                                   f"{(r.stderr or '')[-300:]}")
+            entries = {e for e in os.listdir(cache_dir)
+                       if e.endswith("-cache")}
+            if attempt == 0:
+                first_entries = entries
+                if not entries:
+                    raise RuntimeError(
+                        "first run left the compile cache EMPTY — the "
+                        "persistent cache is not working on this backend")
+            elif entries - first_entries:
+                raise RuntimeError(
+                    f"second run wrote {len(entries - first_entries)} new "
+                    f"cache entries — the cache-hit path recompiled")
+    except Exception as e:            # noqa: BLE001 — any failure fails CI
+        cache_ok, cache_err = False, f"{type(e).__name__}: {e}"
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
     out = {"metric": "smoke_recompile_guard", "rows": n_rows, "iters": iters,
            "post_warmup_cache_misses": report["post_warmup_cache_misses"],
            "host_syncs": report["host_syncs"],
            "resume_post_warmup_cache_misses": resume_misses,
-           "ok": ok and resume_ok}
+           "compile_cache_roundtrip_ok": cache_ok,
+           "ok": ok and resume_ok and cache_ok}
     if err:
         out["error"] = err[:300]
     if resume_err:
         out["resume_error"] = resume_err[:300]
+    if cache_err:
+        out["compile_cache_error"] = cache_err[:300]
     print(json.dumps(out))
-    return 0 if (ok and resume_ok) else 1
+    return 0 if (ok and resume_ok and cache_ok) else 1
 
 
 if __name__ == "__main__":

@@ -1,14 +1,16 @@
 """ON-CHIP equality check: Pallas histogram kernel vs the XLA one-hot
 matmul reference path, on the real TPU backend (tests/test_pallas_hist.py
 runs the same comparison but under the hermetic-CPU conftest in interpret
-mode — this script is the hardware gate for tpu_hist_kernel=auto resolving
-to pallas; the analog of the reference's GPU_DEBUG_COMPARE,
-gpu_tree_learner.cpp:1018-1043).
+mode — this script is the hardware gate behind the EXPLICIT
+tpu_hist_kernel=pallas|mixed knobs; the analog of the reference's
+GPU_DEBUG_COMPARE, gpu_tree_learner.cpp:1018-1043).
 
-On success it writes the marker file read by
-lightgbm_tpu.utils.cache.pallas_validated_on_chip(), which is what flips
-``auto`` from the XLA fallback to the Pallas kernel for every subsequent
-process on this machine (including the driver's end-of-round bench run).
+NOTE: ``auto`` does NOT consult this gate — it always resolves to the XLA
+kernel, the round-5 measured end-to-end best (boosting/gbdt.py kernel-
+resolution block). On success this script writes the per-shape-class TRUST
+marker read by lightgbm_tpu.utils.cache.pallas_validated_on_chip(); a
+booster running an explicit pallas/mixed kernel on real hardware warns
+when its resolved shape class is not in the marker.
 
 Run: python -u exp/pallas_onchip_check.py  (exit 0 iff the marker was
 written, i.e. at least one shape class validated)

@@ -27,8 +27,9 @@ echo "=== 1. QUICK bench (2.1M rows; sparse phase deferred to step 3) ==="
 LGBM_TPU_BENCH_ROWS=2100000 LGBM_TPU_BENCH_SPARSE=0 \
   LGBM_TPU_BENCH_TIMEOUT=900 timeout 1000 \
   python bench.py | tee exp/BENCH_local_r5_quick.json
-echo "=== 2. pallas equality ON-CHIP (per-shape gate; writes the marker"
-echo "       auto consults — exit 0 just means SOME shape validated) ==="
+echo "=== 2. pallas equality ON-CHIP (per-shape gate; writes the trust"
+echo "       marker the explicit pallas/mixed knobs consult — auto always"
+echo "       resolves xla; exit 0 just means SOME shape validated) ==="
 rm -f exp/PALLAS_ONCHIP_OK
 if timeout 1200 python -u exp/pallas_onchip_check.py; then
   touch exp/PALLAS_ONCHIP_OK
@@ -36,13 +37,15 @@ if timeout 1200 python -u exp/pallas_onchip_check.py; then
 else
   echo "PALLAS GATE: nothing validated (auto stays xla)"
 fi
-echo "=== 3. full bench (10.5M, auto -> mixed on gated shapes) ==="
+echo "=== 3. full bench (10.5M; auto always resolves xla — gated shapes"
+echo "       only matter for the explicit LGBM_TPU_BENCH_KERNEL runs) ==="
 LGBM_TPU_BENCH_TIMEOUT=2700 timeout 2900 python bench.py | tee exp/BENCH_local_r5.json
 if [ -f exp/PALLAS_ONCHIP_OK ]; then
-  echo "=== 4. full bench kernel=xla (comparison vs step 3's mixed) ==="
-  LGBM_TPU_BENCH_KERNEL=xla LGBM_TPU_BENCH_SPARSE=0 \
+  echo "=== 4. full bench kernel=mixed (explicit gated kernel, comparison"
+  echo "       vs step 3's auto=xla) ==="
+  LGBM_TPU_BENCH_KERNEL=mixed LGBM_TPU_BENCH_SPARSE=0 \
     LGBM_TPU_BENCH_TIMEOUT=1800 timeout 2000 \
-    python bench.py | tee exp/BENCH_local_r5_xla.json
+    python bench.py | tee exp/BENCH_local_r5_mixed.json
 fi
 echo "=== 5a. bench slots=51 (two rhs MXU tiles, half the waves) ==="
 LGBM_TPU_BENCH_SLOTS=51 LGBM_TPU_BENCH_SPARSE=0 \

@@ -24,6 +24,11 @@ from .gbdt import GBDT
 
 
 class DART(GBDT):
+    # host-side per-iteration drop-set selection + score renormalization
+    # cannot fuse into a device-resident scan — GBDT.__init__ falls back to
+    # tree_batch=1 with a warning
+    supports_tree_batch = False
+
     def __init__(self, config: Config, train_set, objective=None):
         super().__init__(config, train_set, objective)
         Log.info("Using DART")

@@ -64,6 +64,9 @@ class GBDT:
     """Boosting driver (reference class GBDT, src/boosting/gbdt.h:25)."""
 
     average_output = False  # RF overrides (boosting.h average_output_)
+    # fused multi-tree steps (tree_batch > 1) need every per-iteration hook
+    # to be device-resident; DART/GOSS override to False and fall back to 1
+    supports_tree_batch = True
 
     def __init__(self, config: Config, train_set: ConstructedDataset,
                  objective: Optional[Objective] = None):
@@ -211,14 +214,15 @@ class GBDT:
                              plan.max_bundle_bins)
 
         # ---- histogram kernel choice (needs the FINAL kernel shape class,
-        #      hence after EFB planning). "auto": the Pallas VMEM-accumulator
-        #      kernel iff the on-chip gate (exp/pallas_onchip_check.py — the
+        #      hence after EFB planning). "auto" ALWAYS resolves to the XLA
+        #      one-hot matmul — the round-5 measured end-to-end best (see the
+        #      resolution block below). pallas/mixed are explicit opt-in
+        #      knobs; the on-chip gate (exp/pallas_onchip_check.py — the
         #      analog of the reference's GPU_DEBUG_COMPARE,
-        #      gpu_tree_learner.cpp:1018-1043) validated THIS shape class on
-        #      this machine's libtpu; the XLA one-hot matmul otherwise (CPU
-        #      backends, un-gated libtpu, or shapes the gate never ran —
-        #      Mosaic lowering failures are shape-triggered, round-5 gate
-        #      log). Opt in/out explicitly with tpu_hist_kernel=pallas|xla.
+        #      gpu_tree_learner.cpp:1018-1043) records a per-shape-class
+        #      TRUST marker for them (Mosaic lowering failures are
+        #      shape-triggered, round-5 gate log), consulted below to warn
+        #      when an explicit pallas/mixed run hits an un-gated shape.
         # auto slots: 25 x 5 bf16 channels = 125 matmul columns — one full
         # MXU tile (128) — while quartering the wave count at 255 leaves.
         # User-set slot counts clamp to the leaf budget: the wave loop's
@@ -343,6 +347,28 @@ class GBDT:
         else:
             code_mode = code_mode_for(int(max_code), Xb.dtype)
 
+        # explicit pallas/mixed on real hardware: consult the per-shape-class
+        # on-chip trust record (utils/cache.pallas_validated_on_chip). An
+        # un-gated shape class still RUNS — the kernel is equality-tested in
+        # interpret mode on every CI run — but Mosaic lowering failures are
+        # shape-triggered, so the operator should know this exact shape
+        # never executed on this machine's libtpu.
+        if (hist_kernel in ("pallas", "mixed")
+                and self.pctx.devices[0].platform == "tpu"):
+            from ..utils.cache import (pallas_config_key,
+                                       pallas_validated_on_chip)
+            _ck = pallas_config_key(
+                int(np.dtype(Xb.dtype).itemsize), self._hist_bins or Bpad,
+                slots, cols_pad, 5 if config.tpu_hist_hilo else 3)
+            if not pallas_validated_on_chip(_ck):
+                Log.warning(
+                    "tpu_hist_kernel=%s: shape class %s has never passed "
+                    "the on-chip equality gate on this machine/libtpu "
+                    "(exp/pallas_onchip_check.py writes the trust marker) "
+                    "— Mosaic lowering failures are shape-triggered; run "
+                    "the gate or use tpu_hist_kernel=xla if results look "
+                    "wrong", hist_kernel, _ck)
+
         # slots were fixed alongside the kernel choice (they are part of
         # the gated kernel shape class)
         wave = config.tpu_wave_size or slots
@@ -431,7 +457,10 @@ class GBDT:
             jax.random.PRNGKey(config.seed if config.seed else config.bagging_seed))
 
         self.bagging_on = config.bagging_freq > 0 and config.bagging_fraction < 1.0
-        self.bag_mask = self.pad_mask
+        # under bagging the carried mask is DONATED to the step (XLA updates
+        # it in place) — it must own its buffer, never alias pad_mask, which
+        # travels separately as a step constant
+        self.bag_mask = self.pad_mask + 0 if self.bagging_on else self.pad_mask
         self.best_iteration = 0
 
         # non-finite guard (robustness/numeric.py): a trace-time constant —
@@ -441,6 +470,38 @@ class GBDT:
 
         self._step_fn = None
         self._custom_step_fn = None
+
+        # ---- fused multi-tree dispatch (tree_batch) ------------------------
+        # K boosting iterations per jit dispatch via lax.scan: grad/hess,
+        # tree growth, and score updates for K trees never leave HBM, and
+        # the host pays dispatch overhead once per K trees. Requires the
+        # whole per-iteration pipeline to be device-resident, which dart
+        # (host-side drop-set selection) and goss (conservatively, per its
+        # sampling contract) opt out of via supports_tree_batch.
+        tb = max(1, config.tree_batch)
+        if tb > 1 and not self.supports_tree_batch:
+            Log.warning(
+                "tree_batch=%d is not supported with boosting=%s (the "
+                "per-iteration pipeline is not fully device-resident); "
+                "falling back to tree_batch=1", tb,
+                config.boosting_normalized)
+            tb = 1
+        if (tb > 1 and self.average_output
+                and config.nan_policy in ("raise", "skip_iter")):
+            # RF's running-average score weights by the device iteration
+            # counter, which keeps advancing through a batch: a mid-batch
+            # gated no-op would leave phantom iterations in the average
+            # denominator (skip_iter), and raise's rollback would need
+            # trailing trees subtracted — rejected for average_output.
+            # The K=1 paths resync the counter and stay exact.
+            Log.warning(
+                "tree_batch=%d with nan_policy=%s cannot compose with a "
+                "mid-batch skip/rollback under boosting=rf (scores are "
+                "running averages weighted by the iteration counter); "
+                "falling back to tree_batch=1", tb, config.nan_policy)
+            tb = 1
+        self.tree_batch = tb
+        self._batch_step_fns: Dict[int, object] = {}
 
     # ------------------------------------------------------------------ setup
 
@@ -571,7 +632,9 @@ class GBDT:
         return ({a: getattr(self, a) for a in self._STEP_CONSTS},
                 tuple(vs.Xb for vs in self.valid_sets))
 
-    def _make_step(self, custom_grads: bool = False):
+    def _make_step(self, custom_grads: bool = False, batch: int = 1):
+        assert not (custom_grads and batch > 1), \
+            "custom gradients need a host round-trip per tree"
         spec = self.spec
         K = self.num_models
         comm = self.comm
@@ -598,13 +661,37 @@ class GBDT:
             for vs, xb in zip(self.valid_sets, valid_Xb):
                 vs.Xb = xb
             try:
-                return step_body(score, valid_scores, bag_mask, key, it,
-                                 shrinkage, *grads)
+                if batch == 1:
+                    return step_body(score, valid_scores, bag_mask, key, it,
+                                     shrinkage, *grads)
+                return batch_body(score, valid_scores, bag_mask, key, it,
+                                  shrinkage)
             finally:
                 for a, v in saved.items():
                     setattr(self, a, v)
                 for vs, xb in zip(self.valid_sets, saved_vXb):
                     vs.Xb = xb
+
+        def batch_body(score, valid_scores, bag_mask, key, it, shrinkage):
+            # tree_batch fusion: `batch` whole iterations under ONE lax.scan
+            # — the carry (scores, bagging mask, device iteration counter)
+            # stays in HBM between trees; per-iteration trees / leaf counts
+            # (/ non-finite flags) stack along the leading batch axis. The
+            # scan body IS step_body, so K=1 and K>1 run identical math per
+            # iteration (bit-identity is pinned by tests/test_tree_batch.py).
+            def scan_step(carry, _):
+                score, valid_scores, bag_mask, it = carry
+                outs = step_body(score, valid_scores, bag_mask, key, it,
+                                 shrinkage)
+                score, valid_scores, bag_mask = outs[0], outs[1], outs[2]
+                it = outs[5]
+                return (score, valid_scores, bag_mask, it), \
+                    (outs[3], outs[4]) + tuple(outs[6:])
+            (score, valid_scores, bag_mask, it), ys = jax.lax.scan(
+                scan_step, (score, valid_scores, bag_mask, it), None,
+                length=batch)
+            return (score, valid_scores, bag_mask) + tuple(ys[:2]) + (it,) \
+                + tuple(ys[2:])
 
         nan_policy = self.nan_policy
         if nan_policy != "none":
@@ -693,13 +780,34 @@ class GBDT:
             return (out_score, out_valid, mask, tuple(trees),
                     jnp.stack(nleaves), it + 1, nf)
 
-        # donate the score buffers (positions: score=2, valid_scores=3) —
-        # they are rebound to the step's outputs immediately after every
-        # dispatch, so XLA can update them in place instead of allocating
-        # + copying a second [K, Npad] f32 array per step (42 MB at bench
-        # scale). CPU ignores donation with a warning, so gate it.
-        donate = () if self.pctx.devices[0].platform == "cpu" else (2, 3)
+        # donate the training-step carry (positions: score=2,
+        # valid_scores=3, and under bagging bag_mask=4) — every one is
+        # rebound to the step's outputs immediately after each dispatch, so
+        # XLA updates in place instead of allocating + copying a second
+        # [K, Npad] f32 array per step (42 MB at bench scale). bag_mask is
+        # only donated when bagging resamples it (otherwise the step returns
+        # pad_mask, which also travels as a non-donated constant). The
+        # grower's per-tree leaf state and histogram cache live inside the
+        # while_loop carry, which XLA already aliases in place. CPU ignores
+        # donation with a warning, so gate it.
+        donate = () if self.pctx.devices[0].platform == "cpu" else \
+            ((2, 3, 4) if self.bagging_on else (2, 3))
         return jax.jit(step, donate_argnums=donate)
+
+    def _dispatch_prep(self, shrinkage: float):
+        """Shared pre-dispatch protocol of the K=1 and fused-batch paths:
+        device-counter resync, on-device shrinkage cache, valid-score /
+        step-constant assembly. ONE copy so the two dispatchers cannot
+        drift."""
+        if self._iter_dev is None:    # first step / post-rollback resync
+            self._iter_dev = jnp.asarray(self.iter_, jnp.int32)
+        if self._shrink_cache[0] != shrinkage:
+            self._shrink_cache = (shrinkage,
+                                  jnp.asarray(shrinkage, jnp.float32))
+        valid_scores = tuple(tuple(vs.score[k] for k in range(self.num_models))
+                             for vs in self.valid_sets)
+        consts, valid_Xb = self._step_consts()
+        return consts, valid_Xb, valid_scores
 
     def _run_step(self, score, shrinkage: float, custom_gh=None):
         """Dispatch one compiled step against current state; returns new score
@@ -712,14 +820,7 @@ class GBDT:
             if self._custom_step_fn is None:
                 self._custom_step_fn = self._make_step(custom_grads=True)
             fn, extra = self._custom_step_fn, custom_gh
-        if self._iter_dev is None:    # first step / post-rollback resync
-            self._iter_dev = jnp.asarray(self.iter_, jnp.int32)
-        if self._shrink_cache[0] != shrinkage:
-            self._shrink_cache = (shrinkage,
-                                  jnp.asarray(shrinkage, jnp.float32))
-        valid_scores = tuple(tuple(vs.score[k] for k in range(self.num_models))
-                             for vs in self.valid_sets)
-        consts, valid_Xb = self._step_consts()
+        consts, valid_Xb, valid_scores = self._dispatch_prep(shrinkage)
         outs = fn(consts, valid_Xb, score, valid_scores, self.bag_mask,
                   self._rng_key, self._iter_dev, self._shrink_cache[1], *extra)
         nf = None
@@ -784,10 +885,132 @@ class GBDT:
     def train_one_iter(self) -> None:
         with TIMERS("train_step"):
             score, out_valid = self._run_step(self.score,
-                                              self.config.learning_rate)
+                                              self._step_shrinkage())
             self.score = score
             for vi, vs in enumerate(self.valid_sets):
                 vs.score = jnp.stack(out_valid[vi])
+
+    def _step_shrinkage(self) -> float:
+        """Hook: per-tree shrinkage (RF overrides to 1.0, rf.hpp:44-45)."""
+        return self.config.learning_rate
+
+    # --------------------------------------------- fused multi-tree dispatch
+
+    def train_batch(self, n: int) -> None:
+        """Run ``n`` boosting iterations in ONE jit dispatch (tree_batch).
+
+        Equivalent to ``n`` calls of :meth:`train_one_iter` (bit-identical —
+        the scan body is the same ``step_body``), but score updates, tree
+        growth, and leaf application never leave HBM between trees and the
+        host pays dispatch + bookkeeping cost once per batch. Metric eval /
+        callbacks happen at the caller's batch boundaries (engine.py)."""
+        if n <= 1:
+            return self.train_one_iter()
+        with TIMERS("train_step"):
+            self._run_fused_batch(n)
+
+    def _run_fused_batch(self, n: int) -> None:
+        fn = self._batch_step_fns.get(n)
+        if fn is None:
+            fn = self._make_step(batch=n)
+            self._batch_step_fns[n] = fn
+        consts, valid_Xb, valid_scores = self._dispatch_prep(
+            self._step_shrinkage())
+        outs = fn(consts, valid_Xb, self.score, valid_scores, self.bag_mask,
+                  self._rng_key, self._iter_dev, self._shrink_cache[1])
+        nf = None
+        if self.nan_policy != "none":
+            score, out_valid, self.bag_mask, trees, nl, self._iter_dev, nf = outs
+        else:
+            score, out_valid, self.bag_mask, trees, nl, self._iter_dev = outs
+        # per-iteration bookkeeping from the stacked batch outputs: lazy
+        # device-side slices (no host sync), so checkpoints / rollback /
+        # finalize keep their list-of-iterations contract unchanged
+        base_iter = self.iter_
+        base_len = len(self.models)
+        for i in range(n):
+            self.models.append([
+                jax.tree.map(lambda x, i=i: x[i], tk) for tk in trees])
+            self._num_leaves_dev.append(nl[i])
+        self.iter_ += n
+        self.mutations_ = getattr(self, "mutations_", 0) + n
+        self.score = score
+        for vi, vs in enumerate(self.valid_sets):
+            vs.score = jnp.stack(out_valid[vi])
+        if nf is not None:
+            self._apply_nan_policy_batch(nf, base_iter, base_len, n)
+
+    @allowed_host_sync("nan_policy guard: one [K, 3] flag fetch per fused "
+                       "batch, only while the guard is enabled")
+    def _apply_nan_policy_batch(self, nf, base_iter: int, base_len: int,
+                                n: int) -> None:
+        """Batch-boundary leg of the non-finite guard under tree_batch>1:
+        fetch the stacked per-iteration flags once and enforce the policy
+        per inner iteration. A poisoned inner step was already hardware-
+        gated to a bit-identical no-op inside the scan, so recovery drops
+        its (zero-contribution) bookkeeping entry. Unlike the K=1 path, a
+        skipped iteration's RNG draw is consumed — ``iter_`` and the device
+        counter keep advancing through the batch (so no same-key retry
+        spin), which means ``iter_`` counts attempted steps and can exceed
+        ``len(models)`` after drops."""
+        flags = np.asarray(nf)                              # [n, 3]
+        if not flags.any():
+            self._consecutive_skips = 0
+            return
+        from ..robustness.numeric import FLAG_NAMES, NonFiniteError
+
+        def _what(i):
+            return ", ".join(nm for nm, f in zip(FLAG_NAMES, flags[i]) if f)
+
+        if self.nan_policy == "clip":
+            for i in np.nonzero(flags.any(axis=1))[0]:
+                Log.warning("nan_policy=clip: non-finite %s at iteration %d "
+                            "were sanitized (NaN->0, Inf->+/-cap)",
+                            _what(i), base_iter + int(i))
+            self._consecutive_skips = 0
+            return
+        if self.nan_policy == "raise":
+            i = int(np.nonzero(flags.any(axis=1))[0][0])
+            what = _what(i)
+            # roll the batch back to the last clean iteration: trailing
+            # CLEAN trees are subtracted (they trained from the gated carry
+            # and are valid, but "raise" promises state at the failure
+            # point); trailing POISONED entries were gated no-ops whose
+            # trees may hold non-finite leaf values — subtracting those
+            # would NaN-poison the "rolled back" scores, so they are popped
+            # without arithmetic. Finally the first poisoned entry drops.
+            for j in range(n - 1, i, -1):
+                if flags[j].any():
+                    self._pop_last_iteration()
+                else:
+                    self.rollback_one_iter()
+            self._pop_last_iteration()
+            raise NonFiniteError(
+                f"non-finite {what} detected at iteration {base_iter + i} "
+                f"(nan_policy=raise, tree_batch={n}); booster state is "
+                f"rolled back to the last clean iteration and remains "
+                f"checkpointable")
+        # skip_iter: drop poisoned entries (their steps were gated no-ops,
+        # so the carried scores already exclude them); iter_ / the device
+        # counter stay advanced so the RNG stream never reuses a key
+        for i in sorted(np.nonzero(flags.any(axis=1))[0], reverse=True):
+            Log.warning("nan_policy=skip_iter: dropped iteration %d "
+                        "(non-finite %s)", base_iter + int(i), _what(i))
+            del self.models[base_len + int(i)]
+            del self._num_leaves_dev[base_len + int(i)]
+        self.mutations_ = getattr(self, "mutations_", 0) + 1
+        # consecutive-skip accounting walks the batch in order
+        for i in range(n):
+            if flags[i].any():
+                self._consecutive_skips += 1
+                if self._consecutive_skips >= 10:
+                    raise NonFiniteError(
+                        f"nan_policy=skip_iter: {self._consecutive_skips} "
+                        f"consecutive iterations produced non-finite values "
+                        f"— the poison is deterministic, aborting instead "
+                        f"of spinning")
+            else:
+                self._consecutive_skips = 0
 
     # ---------------------------------------------------- custom objective
 
@@ -862,6 +1085,11 @@ class GBDT:
         self.config = new_config
         self.bagging_on = (new_config.bagging_freq > 0
                            and new_config.bagging_fraction < 1.0)
+        if self.bagging_on and self.bag_mask is self.pad_mask:
+            # bagging enabled mid-training: the carried mask is about to be
+            # DONATED by the retraced step, so it must stop aliasing
+            # pad_mask (the same invariant __init__ establishes)
+            self.bag_mask = self.pad_mask + 0
         # Hyperparameters baked into GrowerSpec as trace-time constants take
         # effect by rebuilding the spec and dropping the cached executable.
         spec_changes = {}
@@ -903,6 +1131,7 @@ class GBDT:
         if retrace:
             self._step_fn = None
             self._custom_step_fn = None
+            self._batch_step_fns = {}
 
     def _pop_last_iteration(self) -> None:
         """Drop the last appended iteration's bookkeeping WITHOUT score
@@ -916,17 +1145,20 @@ class GBDT:
         self._iter_dev = None           # device counter resyncs next step
 
     def _check_no_splits(self) -> bool:
-        """Reference gbdt.cpp:465-471: pop the iteration and stop when no tree
-        could split."""
-        if not self._num_leaves_dev:
-            return False
-        nl = np.asarray(self._num_leaves_dev[-1])
-        if (nl <= 1).all():
+        """Reference gbdt.cpp:465-471: pop the no-split iteration(s) and stop
+        when no tree could split. Checked at eval/batch boundaries, so ALL
+        trailing degenerate iterations are popped — under tree_batch>1 (or
+        metric_freq>1) several zero-value single-leaf trees can accumulate
+        between checks."""
+        popped = False
+        while self._num_leaves_dev and \
+                (np.asarray(self._num_leaves_dev[-1]) <= 1).all():
+            self._pop_last_iteration()
+            popped = True
+        if popped:
             Log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements.")
-            self._pop_last_iteration()
-            return True
-        return False
+        return popped
 
     # ------------------------------------------------------------------- eval
 

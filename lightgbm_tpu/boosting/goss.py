@@ -18,6 +18,11 @@ from .gbdt import GBDT
 
 
 class GOSS(GBDT):
+    # conservative: the sampling warm-up boundary (1/learning_rate) and its
+    # interaction with fused batches is unvalidated — GBDT.__init__ falls
+    # back to tree_batch=1 with a warning
+    supports_tree_batch = False
+
     def __init__(self, config: Config, train_set, objective=None):
         super().__init__(config, train_set, objective)
         if config.bagging_freq > 0 and config.bagging_fraction != 1.0:

@@ -38,9 +38,7 @@ class RF(GBDT):
         itf = it.astype(jnp.float32)
         return (old_score_k * itf + contrib) / (itf + 1.0)
 
-    def train_one_iter(self) -> None:
-        # shrinkage is 1 for RF (rf.hpp:44-45)
-        score, out_valid = self._run_step(self.score, 1.0)
-        self.score = score
-        for vi, vs in enumerate(self.valid_sets):
-            vs.score = jnp.stack(out_valid[vi])
+    def _step_shrinkage(self) -> float:
+        # shrinkage is 1 for RF (rf.hpp:44-45); every hook stays
+        # device-resident, so RF keeps tree_batch fusion
+        return 1.0

@@ -20,9 +20,17 @@ class EarlyStopException(Exception):
 
 
 def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
-    """print_evaluation in the reference."""
+    """print_evaluation in the reference. The period check is
+    interval-CROSSING, not modulo: under fused multi-tree steps
+    (tree_batch>1) callbacks only see batch-boundary iteration numbers,
+    which may never hit an exact multiple of ``period`` (identical firing
+    at tree_batch=1)."""
+    state = {"last": 0}
+
     def _callback(env: CallbackEnv) -> None:
-        if period > 0 and env.evaluation_result_list and (env.iteration + 1) % period == 0:
+        if (period > 0 and env.evaluation_result_list
+                and env.iteration + 1 - state["last"] >= period):
+            state["last"] = env.iteration + 1
             result = "\t".join(
                 f"{name}'s {metric}: {value:g}"
                 for name, metric, value, _ in env.evaluation_result_list)

@@ -297,15 +297,24 @@ class Config:
     tpu_row_compact: bool = True
     tpu_compact_frac: float = 0.25            # compact passes below this
                                               # active-row fraction
-    # histogram kernel: "auto" (currently = xla until the pallas path is
-    # equality-checked on real hardware) | "xla" one-hot matmul | "pallas"
-    # fused VMEM-accumulator kernel (ops/pallas_histogram.py, the OpenCL
-    # histogram256.cl analog)
+    # histogram kernel: "auto" (= xla, the round-5 measured end-to-end best;
+    # see boosting/gbdt.py kernel-resolution block) | "xla" one-hot matmul |
+    # "pallas" fused VMEM-accumulator kernel (ops/pallas_histogram.py, the
+    # OpenCL histogram256.cl analog) | "mixed" (pallas for compacted passes
+    # only). pallas/mixed are explicit opt-ins whose trusted shape classes
+    # the on-chip gate records (exp/pallas_onchip_check.py)
     tpu_hist_kernel: str = "auto"
     # per-phase wall-clock accumulators (reference TIMETAG) printed after
     # training; tpu_profile_dir wraps training in a jax.profiler trace
     tpu_time_tag: bool = False
     tpu_profile_dir: str = ""
+    # boosting iterations fused into ONE jit dispatch via lax.scan (built-in
+    # objectives only): score updates, tree growth, and leaf application for
+    # K trees never leave HBM, and the host loop pays dispatch + sync cost
+    # once per K trees instead of per tree. Metric eval, callbacks, and
+    # checkpoints land on batch boundaries; dart/goss and custom objectives
+    # fall back to 1 (loudly). See docs/TPU-Performance.md.
+    tree_batch: int = 1
 
     # --- fault tolerance (robustness/, docs/Fault-Tolerance.md) -------------
     # directory of atomic booster snapshots (ckpt_<id>.pkl); empty = off
@@ -368,6 +377,15 @@ class Config:
         if self.tpu_hist_kernel not in ("auto", "xla", "pallas", "mixed"):
             Log.fatal("Unknown tpu_hist_kernel %s (auto|xla|pallas|mixed)",
                       self.tpu_hist_kernel)
+        if not 0.0 < self.tpu_compact_frac <= 1.0:
+            # <=0 silently disables compaction; >1 forces the argsort+gather
+            # path on every pass (n_active < frac*N is always true)
+            Log.fatal("tpu_compact_frac must be in (0, 1], got %g — values "
+                      "<= 0 disable row compaction entirely and values > 1 "
+                      "force the compacted argsort+gather path on every "
+                      "histogram pass", self.tpu_compact_frac)
+        if self.tree_batch < 1:
+            Log.fatal("tree_batch must be >= 1, got %d", self.tree_batch)
         if self.boosting_type in ("rf", "random_forest"):
             # reference: rf.hpp:18-29 — bagging is mandatory for random forest
             if not (self.bagging_freq > 0 and self.bagging_fraction < 1.0):

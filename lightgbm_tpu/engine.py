@@ -36,6 +36,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
     starts fresh otherwise, so a preempted run restarts with the identical
     command. With ``checkpoint_dir`` + ``checkpoint_interval`` set, a
     snapshot is written every N iterations."""
+    # persistent XLA compile cache (utils/cache.py): honor the
+    # LGBM_TPU_COMPILE_CACHE_DIR knob on every training entry point so
+    # repeated runs (and bench subprocess phases) pay each step compile once
+    from .utils.cache import maybe_enable_compile_cache
+    maybe_enable_compile_cache()
+
     params = dict(params or {})
     if "num_iterations" not in params and "num_boost_round" not in params:
         params["num_iterations"] = num_boost_round
@@ -126,9 +132,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     callbacks = list(callbacks or [])
     if config.checkpoint_dir and config.checkpoint_interval > 0:
+        # interval-CROSSING check, not modulo: under tree_batch>1 the
+        # callback fires at batch boundaries whose iteration numbers jump
+        # by K and may never hit an exact multiple of the interval
+        _ck_state = {"last": start_iter}
+
         def _checkpoint_cb(env):
-            if (env.iteration + 1) % config.checkpoint_interval == 0:
+            if env.iteration + 1 - _ck_state["last"] >= config.checkpoint_interval:
                 env.model.save_checkpoint()
+                _ck_state["last"] = env.iteration + 1
         _checkpoint_cb.order = 40      # after record_evaluation (order 20):
         callbacks.append(_checkpoint_cb)   # the snapshot sees this iter's eval
     if learning_rates is not None:
@@ -163,29 +175,56 @@ def train(params: Dict[str, Any], train_set: Dataset,
     gbdt = booster._gbdt
     eval_needed = bool(gbdt.valid_sets) or gbdt.config.is_training_metric or callbacks_after
     best_iteration = 0
+    # ---- fused multi-tree steps (tree_batch, boosting/gbdt.py) -------------
+    # K iterations per jit dispatch; metric eval, callbacks, checkpoints,
+    # and early stopping land on batch boundaries. Custom objectives need a
+    # host gradient round-trip per tree, so they force K=1 (loudly).
+    tree_batch = getattr(gbdt, "tree_batch", 1)
+    if fobj is not None and tree_batch > 1:
+        Log.warning("tree_batch=%d needs a built-in objective (fobj requires "
+                    "a host round-trip per tree); falling back to "
+                    "tree_batch=1", tree_batch)
+        tree_batch = 1
+    if callbacks_before and tree_batch > 1:
+        # before-iteration callbacks (reset_parameter — incl. the
+        # learning_rates schedule) expect to retune EVERY iteration; under
+        # fusion they would fire once per batch and the whole batch would
+        # train on the batch-start parameters — a silently different model.
+        Log.warning("tree_batch=%d is not supported with before-iteration "
+                    "callbacks (learning_rates / reset_parameter retune "
+                    "per iteration); falling back to tree_batch=1",
+                    tree_batch)
+        tree_batch = 1
+    metric_freq = max(config.metric_freq, 1)
     from .utils.timer import TIMERS, maybe_xla_trace
     if config.tpu_time_tag:
         TIMERS.enabled = True
     try:
         with maybe_xla_trace(config.tpu_profile_dir):
-            for it in range(start_iter, n_rounds):
+            it = start_iter
+            while it < n_rounds:
+                k = min(tree_batch, n_rounds - it)
                 for cb in callbacks_before:
                     cb(CallbackEnv(booster, params, it, 0, n_rounds, None))
                 if fobj is not None:
                     gbdt.train_one_iter_custom(fobj)
                 else:
-                    gbdt.train_one_iter()
+                    gbdt.train_batch(k)
+                it_end = it + k
                 eval_results = []
                 if gbdt.valid_sets or gbdt.config.is_training_metric:
-                    if (it + 1) % max(config.metric_freq, 1) == 0:
+                    # eval when the batch crossed a metric_freq boundary
+                    # (== (it+1) % freq == 0 at k=1)
+                    if it_end // metric_freq > it // metric_freq:
                         eval_results = gbdt.eval_all()
                         if feval is not None:
                             eval_results.extend(_run_feval(feval, gbdt, booster))
                         if gbdt._check_no_splits():
                             break
                 for cb in callbacks_after:
-                    cb(CallbackEnv(booster, params, it, 0, n_rounds,
+                    cb(CallbackEnv(booster, params, it_end - 1, 0, n_rounds,
                                    eval_results))
+                it = it_end
     except EarlyStopException as e:
         best_iteration = e.best_iteration + 1
         booster.best_score = e.best_score

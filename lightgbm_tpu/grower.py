@@ -99,6 +99,12 @@ def decode_bundled_bin(Xb: jnp.ndarray, f: jnp.ndarray,
 
 
 class GrowState(NamedTuple):
+    """Wave-loop carry. Buffer lifetime note: everything here — including
+    the [L+1, F, B, 3] histogram cache, the largest allocation after the
+    code matrix — is `lax.while_loop` carry, which XLA aliases in place
+    across waves; the cross-ITERATION carries (scores, bagging mask) are
+    donated at the jit boundary instead (boosting/gbdt.py `donate_argnums`),
+    so neither layer pays an allocate+copy per update."""
     tree: TreeArrays
     leaf_id: jnp.ndarray          # i32 [N]
     hist: jnp.ndarray             # f32 [L+1, F, B, 3] per-leaf histogram cache

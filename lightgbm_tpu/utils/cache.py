@@ -67,14 +67,37 @@ class LRUCache:
                 "hits": self.hits, "misses": self.misses}
 
 
-def enable_compile_cache(cache_dir: str) -> None:
+def enable_compile_cache(cache_dir: str, min_compile_secs: float = 0.5) -> None:
     import jax
 
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
     except Exception:
         pass
+
+
+def maybe_enable_compile_cache(default_dir: str = "") -> str:
+    """Honor the ``LGBM_TPU_COMPILE_CACHE_DIR`` knob: set = use that
+    directory, ``0``/``off``/``none`` = explicitly disabled, unset = fall
+    back to ``default_dir`` (empty default = leave the cache off).
+
+    Returns the directory actually enabled ("" when disabled). Idempotent —
+    entry points (engine.train, bench.py and its subprocess phases) can all
+    call it; the last call wins, which is fine because they resolve the
+    same knob. The repeated-compile wedges that voided BENCH_r03 and timed
+    out BENCH_r05's optional phases become one-time costs once every phase
+    resolves a shared directory here.
+    """
+    d = os.environ.get("LGBM_TPU_COMPILE_CACHE_DIR")
+    if d is not None and d.strip().lower() in ("0", "off", "none", ""):
+        return ""
+    d = d or default_dir
+    if not d:
+        return ""
+    enable_compile_cache(d)
+    return d
 
 
 def repo_cache_dir() -> str:
@@ -123,12 +146,15 @@ def pallas_kernel_source_hash() -> str:
 def pallas_config_key(code_bytes: int, num_bins: int, num_slots: int,
                       num_features: int, num_channels: int = 5) -> str:
     """Stable name for one kernel shape class — what the on-chip gate
-    validates and what ``tpu_hist_kernel=auto`` looks up. Mosaic lowering
-    failures observed in round 5 were shape-triggered (the S=25 x ch=5
-    accumulator, the cb=2 byte-combine), so trust is granted per shape,
-    not per kernel. The weight-channel count is part of the shape (the
-    accumulator is [S*ch padded, F*B]): tpu_hist_hilo=false runs ch=3
-    blocks the gate's default ch=5 sweep never executed."""
+    validates and what the EXPLICIT ``tpu_hist_kernel=pallas|mixed`` knobs
+    consult on a real TPU backend to warn about never-gated shapes
+    (``auto`` always resolves to xla, the round-5 measured end-to-end best
+    — boosting/gbdt.py kernel-resolution block). Mosaic lowering failures
+    observed in round 5 were shape-triggered (the S=25 x ch=5 accumulator,
+    the cb=2 byte-combine), so trust is granted per shape, not per kernel.
+    The weight-channel count is part of the shape (the accumulator is
+    [S*ch padded, F*B]): tpu_hist_hilo=false runs ch=3 blocks the gate's
+    default ch=5 sweep never executed."""
     return (f"u{8 * code_bytes}_b{num_bins}_s{num_slots}"
             f"_f{num_features}_c{num_channels}")
 
@@ -139,13 +165,15 @@ def pallas_validated_on_chip(config_key=None) -> bool:
     for ``config_key``'s shape class when the marker carries a per-config
     list (round-5 gates onward; ``pallas_config_key`` builds keys).
 
-    This is how ``tpu_hist_kernel=auto`` decides between the Pallas
-    VMEM-accumulator kernel and the XLA one-hot-matmul fallback: the
-    kernel is equality-tested in interpret mode on every CI run, but
-    Mosaic lowering on a particular libtpu is only trusted after the
-    hardware gate has actually executed there — the same role as the
-    reference's GPU_DEBUG_COMPARE self-check
-    (gpu_tree_learner.cpp:1018-1043) played for its OpenCL kernels.
+    This is the TRUST RECORD behind the explicit ``tpu_hist_kernel=
+    pallas|mixed`` knobs (``auto`` always resolves to xla — the round-5
+    measured end-to-end best): the booster consults it on a real TPU and
+    warns when the resolved shape class was never gated. The kernel is
+    equality-tested in interpret mode on every CI run, but Mosaic lowering
+    on a particular libtpu is only trusted after the hardware gate has
+    actually executed there — the same role as the reference's
+    GPU_DEBUG_COMPARE self-check (gpu_tree_learner.cpp:1018-1043) played
+    for its OpenCL kernels.
 
     The marker records the jax version it was earned under; a runtime
     upgrade invalidates it (Mosaic lowering differences across libtpu

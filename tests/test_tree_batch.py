@@ -1,0 +1,274 @@
+"""Fused multi-tree training steps (config tree_batch, boosting/gbdt.py).
+
+Pins the tentpole contracts of the dispatch-overhead PR:
+
+- tree_batch=K training is BIT-identical to K=1 — the scan body is the same
+  step_body, so every tree, score, and prediction must match exactly, for
+  serial and for the row-sharded data-parallel learner, including bagging /
+  feature_fraction RNG streams and a non-divisible final partial batch;
+- the steady-state batched loop performs at most one device->host transfer
+  per K trees (RecompileGuard transfer counters — the runtime analog of
+  lint rule R002) and never recompiles after warm-up;
+- dart/goss and custom objectives fall back to K=1 loudly, never silently
+  train a different algorithm;
+- the nan_policy guard composes: flags are fetched once per batch, poisoned
+  iterations are dropped as gated no-ops, deterministic poison still aborts.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis.guards import RecompileGuard
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _make_binary(n=1500, f=10, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    logit = X[:, 0] - 0.5 * X[:, 1] + X[:, 2] * X[:, 3]
+    y = (logit + rng.randn(n).astype(np.float32) * 0.2 > 0.3).astype(
+        np.float32)
+    return X, y
+
+
+BASE = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+            min_data_in_leaf=5, device="cpu", verbose=-1, seed=5,
+            bagging_fraction=0.7, bagging_freq=2, feature_fraction=0.8)
+
+
+def _train(X, y, tree_batch, tree_learner="serial", rounds=10, **extra):
+    params = dict(BASE, tree_batch=tree_batch, tree_learner=tree_learner,
+                  **extra)
+    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+@pytest.mark.parametrize("tree_learner", ["serial", "data"])
+def test_tree_batch_bit_identical(tree_learner):
+    # rounds=10, K=4 exercises full batches AND the final partial batch (2)
+    X, y = _make_binary()
+    b1 = _train(X, y, 1, tree_learner)
+    b4 = _train(X, y, 4, tree_learner)
+    assert len(b1.trees) == len(b4.trees) == 10
+    np.testing.assert_array_equal(b1.predict(X), b4.predict(X))
+    np.testing.assert_array_equal(
+        b1.predict(X, raw_score=True), b4.predict(X, raw_score=True))
+    # tree-level identity, not just aggregate predictions
+    for t1, t4 in zip(b1.trees, b4.trees):
+        np.testing.assert_array_equal(t1.leaf_value, t4.leaf_value)
+        np.testing.assert_array_equal(t1.split_feature, t4.split_feature)
+
+
+def test_tree_batch_eight_with_eval_history():
+    # K=8 with a valid set: eval lands on batch boundaries only, and the
+    # recorded values must equal the K=1 run's values at those iterations
+    X, y = _make_binary()
+    params = dict(BASE, metric="binary_logloss")
+    ev1, ev8 = {}, {}
+    ds = lambda: lgb.Dataset(X, label=y)  # noqa: E731
+    lgb.train(dict(params, tree_batch=1), ds(), num_boost_round=16,
+              valid_sets=[ds()], valid_names=["v"], evals_result=ev1,
+              verbose_eval=False)
+    lgb.train(dict(params, tree_batch=8), ds(), num_boost_round=16,
+              valid_sets=[ds()], valid_names=["v"], evals_result=ev8,
+              verbose_eval=False)
+    l1 = ev1["v"]["binary_logloss"]
+    l8 = ev8["v"]["binary_logloss"]
+    assert len(l1) == 16 and len(l8) == 2          # batch boundaries only
+    assert l8[0] == l1[7] and l8[1] == l1[15]
+
+
+def test_tree_batch_steady_state_transfers_and_recompiles():
+    """The regression test the ISSUE asks for: under tree_batch=K the
+    steady-state loop performs <= 1 device->host transfer per K trees and
+    zero jit cache misses (one warm executable per batch size)."""
+    X, y = _make_binary()
+    params = dict(BASE, tree_batch=4, metric="none")
+    bst = lgb.Booster(params=params,
+                      train_set=lgb.Dataset(X, label=y, params=params))
+    g = bst._gbdt
+    assert g.tree_batch == 4
+    for _ in range(2):       # warm-up: first-dispatch compile + the
+        g.train_batch(4)     # committed-sharding steady-state variant
+    import jax
+    jax.block_until_ready(g.score)
+    guard = RecompileGuard(label="tree_batch", fail=True)
+    guard.register(g._batch_step_fns[4], "batch_step")
+    n_batches = 3
+    with guard:
+        guard.mark_warm()
+        for _ in range(n_batches):
+            g.train_batch(4)
+    # nan_policy=none + no eval: the batched loop is fully async — ZERO
+    # implicit host syncs, not merely <= 1 per batch
+    assert guard.transfers == 0
+    assert guard.report()["post_warmup_cache_misses"] == 0
+    assert len(g.models) == 20
+
+
+def test_tree_batch_nan_policy_one_fetch_per_batch():
+    """nan_policy=skip_iter under tree_batch: the [K, 3] flag fetch is the
+    ONE permitted host sync per fused batch."""
+    X, y = _make_binary()
+    params = dict(BASE, tree_batch=4, metric="none", nan_policy="skip_iter")
+    bst = lgb.Booster(params=params,
+                      train_set=lgb.Dataset(X, label=y, params=params))
+    g = bst._gbdt
+    for _ in range(2):       # warm-up: first-dispatch compile + the
+        g.train_batch(4)     # committed-sharding steady-state variant
+    import jax
+    jax.block_until_ready(g.score)
+    guard = RecompileGuard(label="tree_batch_nan", fail=True)
+    guard.register(g._batch_step_fns[4], "batch_step")
+    n_batches = 3
+    with guard:
+        guard.mark_warm()
+        for _ in range(n_batches):
+            g.train_batch(4)
+    # on the CPU backend np.asarray is zero-copy and may bypass the patched
+    # sync surface, so assert the budget, not an exact count
+    assert guard.transfers <= n_batches
+    assert guard.report()["post_warmup_cache_misses"] == 0
+    assert len(g.models) == 20                     # nothing dropped: clean run
+
+
+def test_tree_batch_skip_iter_drops_poisoned_iterations():
+    """Deterministic poison (an inf weight makes every iteration's gradients
+    non-finite): each batch's iterations are gated no-op steps, their
+    entries are dropped, and the consecutive-skip abort still fires."""
+    from lightgbm_tpu.robustness.numeric import NonFiniteError
+    X, y = _make_binary(n=400)
+    w = np.ones(400, np.float32)
+    w[7] = np.inf
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=5,
+                  device="cpu", verbose=-1, nan_policy="skip_iter",
+                  tree_batch=4, metric="none")
+    bst = lgb.Booster(params=params, train_set=lgb.Dataset(
+        X, label=y, weight=w, params=params))
+    g = bst._gbdt
+    with pytest.raises(NonFiniteError, match="consecutive"):
+        for _ in range(4):
+            g.train_batch(4)
+    assert len(g.models) == 0                      # every iteration dropped
+    # scores stayed bit-identical to the initial model (gated no-ops)
+    assert np.isfinite(np.asarray(g.score)).all()
+
+
+def test_tree_batch_raise_mid_batch_rollback_bookkeeping():
+    """raise with a POISONED iteration mid-batch: trailing clean trees are
+    subtracted (rollback), trailing poisoned entries are popped WITHOUT
+    arithmetic (their trees may hold non-finite leaf values), and the
+    booster lands on the last clean iteration with finite scores."""
+    from lightgbm_tpu.robustness.numeric import NonFiniteError
+    X, y = _make_binary(n=600)
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=5,
+                  device="cpu", verbose=-1, nan_policy="raise",
+                  tree_batch=4, metric="none")
+    bst = lgb.Booster(params=params, train_set=lgb.Dataset(
+        X, label=y, params=params))
+    g = bst._gbdt
+    g.train_batch(4)
+    assert len(g.models) == 4
+    flags = np.zeros((4, 3), bool)
+    flags[1, 0] = True                     # first poison at i=1
+    flags[3, 1] = True                     # trailing poison at i=3
+    with pytest.raises(NonFiniteError, match="rolled back"):
+        g._apply_nan_policy_batch(flags, base_iter=0, base_len=0, n=4)
+    assert len(g.models) == 1              # only iteration 0 kept
+    assert np.isfinite(np.asarray(g.score)).all()
+
+
+def test_tree_batch_rf_skip_iter_falls_back():
+    X, y = _make_binary(n=600)
+    params = dict(objective="regression", boosting="rf", num_leaves=7,
+                  min_data_in_leaf=5, device="cpu", verbose=-1,
+                  bagging_fraction=0.6, bagging_freq=1, tree_batch=4,
+                  nan_policy="skip_iter", metric="none")
+    bst = lgb.Booster(params=params, train_set=lgb.Dataset(
+        X, label=y, params=params))
+    assert bst._gbdt.tree_batch == 1       # running average vs phantom iters
+
+
+def test_tree_batch_clip_policy_trains():
+    X, y = _make_binary(n=400)
+    w = np.ones(400, np.float32)
+    w[7] = np.inf
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=5,
+                  device="cpu", verbose=-1, nan_policy="clip",
+                  tree_batch=4, metric="none")
+    bst = lgb.Booster(params=params, train_set=lgb.Dataset(
+        X, label=y, weight=w, params=params))
+    for _ in range(2):
+        bst._gbdt.train_batch(4)
+    assert len(bst._gbdt.models) == 8
+    assert np.isfinite(np.asarray(bst._gbdt.score)).all()
+
+
+@pytest.mark.parametrize("boosting", ["goss", "dart"])
+def test_tree_batch_falls_back_for_goss_dart(boosting):
+    X, y = _make_binary(n=600)
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=5,
+                  device="cpu", verbose=-1, boosting=boosting, tree_batch=4,
+                  metric="none", learning_rate=0.1)
+    bst = lgb.Booster(params=params,
+                      train_set=lgb.Dataset(X, label=y, params=params))
+    assert bst._gbdt.tree_batch == 1               # loud config-time fallback
+
+
+def test_tree_batch_learning_rates_falls_back():
+    """A per-iteration learning-rate schedule (reset_parameter before-
+    callback) cannot apply mid-batch — train() must fall back to K=1 and
+    produce the identical model, not silently train the whole batch on the
+    batch-start rate."""
+    X, y = _make_binary(n=600)
+    lrs = [0.3, 0.05, 0.05, 0.05]
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=5,
+                  device="cpu", verbose=-1, metric="none")
+    b_batched = lgb.train(dict(params, tree_batch=4), lgb.Dataset(X, label=y),
+                          num_boost_round=4, learning_rates=lrs)
+    b_plain = lgb.train(dict(params, tree_batch=1), lgb.Dataset(X, label=y),
+                        num_boost_round=4, learning_rates=lrs)
+    np.testing.assert_array_equal(b_batched.predict(X), b_plain.predict(X))
+
+
+def test_tree_batch_custom_objective_falls_back():
+    X, y = _make_binary(n=600)
+
+    def fobj(preds, ds):
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - y, p * (1 - p)
+
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=5,
+                  device="cpu", verbose=-1, tree_batch=4, metric="none")
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3,
+                    fobj=fobj)
+    assert len(bst.trees) == 3                     # one tree per iteration
+
+
+def test_tree_batch_checkpoint_resume_bit_identical(tmp_path):
+    """Checkpoints land on batch boundaries; a resumed batched run must
+    finish bit-identical to the uninterrupted one."""
+    X, y = _make_binary()
+    ck = str(tmp_path / "ck")
+    params = dict(BASE, tree_batch=4, metric="none",
+                  checkpoint_dir=ck, checkpoint_interval=4)
+    full = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=12)
+    # interrupted run: stop after 8 iterations (2 batches), resume to 12
+    lgb.train(dict(params), lgb.Dataset(X, label=y), num_boost_round=8)
+    resumed = lgb.train(dict(params, resume_from="auto"),
+                        lgb.Dataset(X, label=y), num_boost_round=12)
+    np.testing.assert_array_equal(full.predict(X), resumed.predict(X))
+
+
+def test_config_validates_tree_batch_and_compact_frac():
+    from lightgbm_tpu.config import Config
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(tree_batch=0))
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(tpu_compact_frac=0.0))
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(tpu_compact_frac=-0.5))
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(tpu_compact_frac=1.5))
+    assert Config.from_params(dict(tpu_compact_frac=1.0)).tpu_compact_frac == 1.0
+    assert Config.from_params(dict(tree_batch=8)).tree_batch == 8
