@@ -31,6 +31,7 @@ import subprocess
 import sys
 import time
 import traceback
+from contextlib import contextmanager
 
 import numpy as np
 
@@ -56,6 +57,47 @@ _PROBE_CODE = (_HERMETIC if _FORCE_CPU else "") + (
 
 class BenchTimeout(Exception):
     pass
+
+
+class PhaseTimeout(Exception):
+    """One OPTIONAL phase exceeded its private watchdog subdeadline —
+    caught at the phase boundary so the JSON degrades (an *_error field)
+    instead of the whole-run alarm voiding the headline (BENCH_r05 banked
+    auc:null exactly this way)."""
+
+
+@contextmanager
+def _phase_watchdog(name, seconds):
+    """Hard per-phase subdeadline on top of the global SIGALRM watchdog.
+
+    Pauses the global alarm, re-arms SIGALRM to min(phase budget, what the
+    global budget has left minus a margin) with a handler that raises
+    PhaseTimeout, and on exit restores the global alarm minus the time the
+    phase consumed — the whole-run BenchTimeout contract is unchanged. A
+    wedged native call may not be interruptible (SIGALRM fires between
+    bytecodes), which is why the truly wedge-prone phases also run in
+    killable subprocesses; this guard bounds everything interruptible."""
+    remaining = signal.alarm(0)               # pause the global watchdog
+    if remaining:
+        budget = int(max(1, min(seconds, remaining - 10)))
+    else:
+        budget = int(max(1, seconds))
+    prev = signal.getsignal(signal.SIGALRM)
+
+    def on_alarm(signum, frame):
+        raise PhaseTimeout(
+            f"phase {name!r} exceeded its {budget}s watchdog subdeadline")
+
+    t0 = time.time()
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(budget)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
+        if remaining:
+            signal.alarm(max(1, int(remaining - (time.time() - t0))))
 
 
 class ProbeFailed(RuntimeError):
@@ -247,6 +289,11 @@ def run_sparse_phase():
                 metric="none")
     for tag, efb in (("efb", True), ("noefb", False)):
         params = dict(base, enable_bundle=efb)
+        # honest arm naming: record each arm's exact enable_bundle setting
+        # next to its numbers — "noefb" is an explicit enable_bundle=false
+        # run, not a default (round-5 measured EFB *hurting* TPU throughput
+        # 1.1 vs 3.8 Mrow-tree/s here, so both arms must be unambiguous)
+        out[f"sparse_arm_{tag}"] = f"enable_bundle={str(efb).lower()}"
         ds = lgb.Dataset(X, label=y, params=params)
         b = lgb.Booster(params=params, train_set=ds)
         if efb:
@@ -404,40 +451,48 @@ def run_bench(deadline, attempt=0, platform=None):
     if (n_rows > quick_rows
             and os.environ.get("LGBM_TPU_BENCH_QUICK", "1") != "0"):
         try:
-            qbin = os.path.join(
-                cache_dir,
-                f"higgs_{quick_rows}_{src_hash.hexdigest()[:10]}_b255.bin")
-            if os.path.exists(qbin):
-                dq = lgb.Dataset(qbin)
-            else:
-                # standalone gen, NOT a slice of the big matrix: the same
-                # qbin file is also built by exp/harvest_window.py and the
-                # cache pre-builder, and all writers must agree on content
-                Xq, yq = _higgs_like(quick_rows)
-                dq = lgb.Dataset(Xq, label=yq, params=params)
-                dq.construct()
-                dq.save_binary(qbin + ".tmp")
-                os.replace(qbin + ".tmp", qbin)
-            bq = lgb.Booster(params=params, train_set=dq)
-            # same fused dispatch path as the headline — the pre-banked
-            # number must measure the same thing it stands in for
-            elq, _, q_timed = _timed_update_phase(
-                "quick", bq, 2, 8, timings, tree_batch=bq._gbdt.tree_batch)
-            tq = quick_rows * q_timed / elq / 1e6
-            _PARTIAL["result"] = {
-                "metric": "higgs_train_throughput",
-                "value": _round_tp(tq),
-                "unit": "Mrow-tree/s",
-                "vs_baseline": _round_ratio(tq / BASELINE_MROW_TREE_PER_S),
-                "platform": platform,
-                "rows": quick_rows,
-                "kernel": bq._gbdt.spec.hist_kernel,
-                "attempt": attempt,
-                "phase_timings": timings,
-                "note": ("quick-scale pre-bank; the full-scale phase did "
-                         "not complete"),
-            }
-            del bq, dq
+            # private watchdog: a wedged quick phase must leave the bulk of
+            # the budget to the full-scale headline, not eat the global alarm
+            with _phase_watchdog("quick",
+                                 min(max(deadline() - 300, 60), 600)):
+                qbin = os.path.join(
+                    cache_dir,
+                    f"higgs_{quick_rows}_{src_hash.hexdigest()[:10]}"
+                    f"_b255.bin")
+                if os.path.exists(qbin):
+                    dq = lgb.Dataset(qbin)
+                else:
+                    # standalone gen, NOT a slice of the big matrix: the
+                    # same qbin file is also built by exp/harvest_window.py
+                    # and the cache pre-builder, and all writers must agree
+                    # on content
+                    Xq, yq = _higgs_like(quick_rows)
+                    dq = lgb.Dataset(Xq, label=yq, params=params)
+                    dq.construct()
+                    dq.save_binary(qbin + ".tmp")
+                    os.replace(qbin + ".tmp", qbin)
+                bq = lgb.Booster(params=params, train_set=dq)
+                # same fused dispatch path as the headline — the pre-banked
+                # number must measure the same thing it stands in for
+                elq, _, q_timed = _timed_update_phase(
+                    "quick", bq, 2, 8, timings,
+                    tree_batch=bq._gbdt.tree_batch)
+                tq = quick_rows * q_timed / elq / 1e6
+                _PARTIAL["result"] = {
+                    "metric": "higgs_train_throughput",
+                    "value": _round_tp(tq),
+                    "unit": "Mrow-tree/s",
+                    "vs_baseline": _round_ratio(
+                        tq / BASELINE_MROW_TREE_PER_S),
+                    "platform": platform,
+                    "rows": quick_rows,
+                    "kernel": bq._gbdt.spec.hist_kernel,
+                    "attempt": attempt,
+                    "phase_timings": timings,
+                    "note": ("quick-scale pre-bank; the full-scale phase "
+                             "did not complete"),
+                }
+                del bq, dq
         except BenchTimeout:
             raise                  # the watchdog alarm is one-shot: swallowing
                                    # it here would leave the full-scale phase
@@ -507,47 +562,65 @@ def run_bench(deadline, attempt=0, platform=None):
     # watchdog, main() still reports it
     _PARTIAL["result"] = dict(result)
 
-    # ---- AUC on held-out rows (quality alongside every perf claim) --------
-    if deadline() > 60:
-        result["iters_for_auc"] = len(bst._gbdt.models)
-        bst._finalize()
-        result["auc"] = round(_auc(yt, bst.predict(Xt)), 6)
+    # ---- AUC on held-out rows: part of the HEADLINE phase -----------------
+    # Computed here, BEFORE any optional phase can wedge, and re-banked into
+    # _PARTIAL: BENCH_r05 hit the global 900s alarm in a later phase and
+    # published the headline with auc:null. A throughput claim without its
+    # quality check is not a result — the AUC rides inside the headline
+    # snapshot, under its own subdeadline so even a wedged predict degrades
+    # to an auc_error field instead of voiding the JSON.
+    try:
+        if deadline() > 60:
+            with _phase_watchdog("headline_auc",
+                                 min(max(deadline() - 45, 45), 480)):
+                result["iters_for_auc"] = len(bst._gbdt.models)
+                bst._finalize()
+                result["auc"] = round(_auc(yt, bst.predict(Xt)), 6)
+    except BenchTimeout:
+        raise
+    except Exception as e:                                   # noqa: BLE001
+        result["auc_error"] = str(e)[:200]
+    _PARTIAL["result"] = dict(result)
 
     # Optional phases below must never void the headline result — a failure
-    # or timeout there is recorded, not propagated.
+    # or timeout there is recorded, not propagated; each runs behind its own
+    # hard watchdog subdeadline (PhaseTimeout lands in the phase's *_error
+    # field) so a hang degrades the JSON instead of voiding it.
 
     # ---- lambdarank companion: MS-LTR shape (docs/Experiments.rst:21,110) --
     # times the padded-query-bucket pairwise objective end-to-end and checks
     # ranking quality via NDCG@10 on held-out queries
     try:
         if deadline() > 300 and not headline_only:
-            n_rank = int(os.environ.get(
-                "LGBM_TPU_BENCH_RANK_ROWS",
-                str(2_270_296 if platform != "cpu" else 120_000)))
-            n_rank_hold = max(n_rank // 10, 10_000)
-            Xr, yr, gr = _msltr_like(n_rank + n_rank_hold)
-            cum = np.cumsum(gr)
-            n_tr_q = int(np.searchsorted(cum, n_rank))
-            n_tr = int(cum[n_tr_q - 1]) if n_tr_q else 0
-            rank_params = dict(
-                objective="lambdarank", num_leaves=255, max_bin=255,
-                learning_rate=0.1, min_data_in_leaf=100, verbose=-1,
-                metric="none", tpu_hist_kernel=kernel)
-            dsr = lgb.Dataset(Xr[:n_tr], label=yr[:n_tr], group=gr[:n_tr_q])
-            br = lgb.Booster(params=rank_params, train_set=dsr)
-            elr, _, rank_timed = _timed_update_phase("ranking", br, 2, 6,
-                                                     timings)
-            rank_tp = n_tr * rank_timed / elr / 1e6
-            result["ranking_mrow_tree_per_s"] = _round_tp(rank_tp)
-            result["ranking_vs_baseline"] = _round_ratio(
-                rank_tp / RANK_BASELINE_MROW_TREE_PER_S)
-            result["ranking_rows"] = n_tr
-            if deadline() > 60:
-                br._finalize()
-                result["ranking_ndcg10"] = round(
-                    _ndcg10(yr[n_tr:], br.predict(Xr[n_tr:]),
-                            gr[n_tr_q:]), 6)
-            del br, dsr
+            with _phase_watchdog("ranking", min(deadline() - 180, 900)):
+                n_rank = int(os.environ.get(
+                    "LGBM_TPU_BENCH_RANK_ROWS",
+                    str(2_270_296 if platform != "cpu" else 120_000)))
+                n_rank_hold = max(n_rank // 10, 10_000)
+                Xr, yr, gr = _msltr_like(n_rank + n_rank_hold)
+                cum = np.cumsum(gr)
+                n_tr_q = int(np.searchsorted(cum, n_rank))
+                n_tr = int(cum[n_tr_q - 1]) if n_tr_q else 0
+                rank_params = dict(
+                    objective="lambdarank", num_leaves=255, max_bin=255,
+                    learning_rate=0.1, min_data_in_leaf=100, verbose=-1,
+                    metric="none", tpu_hist_kernel=kernel)
+                dsr = lgb.Dataset(Xr[:n_tr], label=yr[:n_tr],
+                                  group=gr[:n_tr_q])
+                br = lgb.Booster(params=rank_params, train_set=dsr)
+                elr, _, rank_timed = _timed_update_phase("ranking", br, 2, 6,
+                                                         timings)
+                rank_tp = n_tr * rank_timed / elr / 1e6
+                result["ranking_mrow_tree_per_s"] = _round_tp(rank_tp)
+                result["ranking_vs_baseline"] = _round_ratio(
+                    rank_tp / RANK_BASELINE_MROW_TREE_PER_S)
+                result["ranking_rows"] = n_tr
+                if deadline() > 60:
+                    br._finalize()
+                    result["ranking_ndcg10"] = round(
+                        _ndcg10(yr[n_tr:], br.predict(Xr[n_tr:]),
+                                gr[n_tr_q:]), 6)
+                del br, dsr
     except BenchTimeout:
         raise
     except Exception as e:                                   # noqa: BLE001
@@ -562,27 +635,30 @@ def run_bench(deadline, attempt=0, platform=None):
     try:
         ref_dir = "/root/reference/examples/binary_classification"
         if deadline() > 240 and platform != "cpu" and os.path.isdir(ref_dir):
-            tr = np.loadtxt(os.path.join(ref_dir, "binary.train"))
-            te = np.loadtxt(os.path.join(ref_dir, "binary.test"))
-            ref_params = dict(
-                objective="binary", num_leaves=63, max_bin=255,
-                learning_rate=0.1, min_data_in_leaf=50,
-                min_sum_hessian_in_leaf=5.0, feature_fraction=0.8,
-                bagging_fraction=0.8, bagging_freq=5, verbose=-1,
-                metric="none", tpu_hist_kernel=kernel)
-            bref = lgb.train(ref_params,
-                             lgb.Dataset(tr[:, 1:], label=tr[:, 0]),
-                             num_boost_round=100)
-            result["reference_example_auc"] = round(
-                _auc(te[:, 0], bref.predict(te[:, 1:])), 6)
-            # the reference CLI's valid auc on this exact run (train.conf,
-            # 100 iters) — loaded from the provenance fixture written by
-            # tests/gen_oracles.py (config/data hashes recorded there)
-            with open(os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)), "tests",
-                    "fixtures", "oracles.json")) as fh:
-                result["reference_example_auc_oracle"] = \
-                    json.load(fh)["bench_reference_example"]["auc"]
+            with _phase_watchdog("reference_example",
+                                 min(deadline() - 150, 420)):
+                tr = np.loadtxt(os.path.join(ref_dir, "binary.train"))
+                te = np.loadtxt(os.path.join(ref_dir, "binary.test"))
+                ref_params = dict(
+                    objective="binary", num_leaves=63, max_bin=255,
+                    learning_rate=0.1, min_data_in_leaf=50,
+                    min_sum_hessian_in_leaf=5.0, feature_fraction=0.8,
+                    bagging_fraction=0.8, bagging_freq=5, verbose=-1,
+                    metric="none", tpu_hist_kernel=kernel)
+                bref = lgb.train(ref_params,
+                                 lgb.Dataset(tr[:, 1:], label=tr[:, 0]),
+                                 num_boost_round=100)
+                result["reference_example_auc"] = round(
+                    _auc(te[:, 0], bref.predict(te[:, 1:])), 6)
+                # the reference CLI's valid auc on this exact run
+                # (train.conf, 100 iters) — loaded from the provenance
+                # fixture written by tests/gen_oracles.py (config/data
+                # hashes recorded there)
+                with open(os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)), "tests",
+                        "fixtures", "oracles.json")) as fh:
+                    result["reference_example_auc_oracle"] = \
+                        json.load(fh)["bench_reference_example"]["auc"]
     except BenchTimeout:
         raise
     except Exception as e:                                   # noqa: BLE001
@@ -592,24 +668,27 @@ def run_bench(deadline, attempt=0, platform=None):
     # the reference's own GPU benchmark config; 4x narrower histograms) -----
     try:
         if deadline() > 240 and not headline_only:
-            bin63 = os.path.join(cache_dir, key + "_b63.bin")
-            if os.path.exists(bin63):
-                ds63 = lgb.Dataset(bin63)
-            else:
-                ds63 = lgb.Dataset(np.asarray(X), label=np.asarray(y),
-                                   params=dict(params, max_bin=63))
-                ds63.construct()
-                ds63.save_binary(bin63 + ".tmp")
-                os.replace(bin63 + ".tmp", bin63)
-            b63 = lgb.Booster(params=dict(params, max_bin=63), train_set=ds63)
-            # same dispatch mode as the headline: the 63-bin comparison must
-            # isolate bin width, not re-add the per-tree dispatch overhead
-            el63, _, it63 = _timed_update_phase(
-                "gpu_config", b63, 2, 8, timings,
-                tree_batch=b63._gbdt.tree_batch)
-            result["gpu_config_mrow_tree_per_s"] = _round_tp(
-                n_rows * it63 / el63 / 1e6)
-            del b63, ds63
+            with _phase_watchdog("gpu_config", min(deadline() - 150, 900)):
+                bin63 = os.path.join(cache_dir, key + "_b63.bin")
+                if os.path.exists(bin63):
+                    ds63 = lgb.Dataset(bin63)
+                else:
+                    ds63 = lgb.Dataset(np.asarray(X), label=np.asarray(y),
+                                       params=dict(params, max_bin=63))
+                    ds63.construct()
+                    ds63.save_binary(bin63 + ".tmp")
+                    os.replace(bin63 + ".tmp", bin63)
+                b63 = lgb.Booster(params=dict(params, max_bin=63),
+                                  train_set=ds63)
+                # same dispatch mode as the headline: the 63-bin comparison
+                # must isolate bin width, not re-add the per-tree dispatch
+                # overhead
+                el63, _, it63 = _timed_update_phase(
+                    "gpu_config", b63, 2, 8, timings,
+                    tree_batch=b63._gbdt.tree_batch)
+                result["gpu_config_mrow_tree_per_s"] = _round_tp(
+                    n_rows * it63 / el63 / 1e6)
+                del b63, ds63
     except BenchTimeout:
         raise
     except Exception as e:                                   # noqa: BLE001
@@ -628,10 +707,13 @@ def run_bench(deadline, attempt=0, platform=None):
                 # timeout slice is not burned on recompiles of kernels this
                 # process (or a previous run) already compiled
                 sp_env["LGBM_TPU_COMPILE_CACHE_DIR"] = compile_cache_dir
-            sp_out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--sparse"],
-                timeout=int(min(deadline() - 210, 1500)),
-                capture_output=True, text=True, env=sp_env)
+            # double-guarded: the subprocess timeout kills a wedged child,
+            # the watchdog bounds THIS process (spawn/IO can wedge too)
+            with _phase_watchdog("sparse", min(deadline() - 200, 1560)):
+                sp_out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--sparse"],
+                    timeout=int(min(deadline() - 210, 1500)),
+                    capture_output=True, text=True, env=sp_env)
             if sp_out.returncode == 0 and sp_out.stdout.strip():
                 result.update(
                     json.loads(sp_out.stdout.strip().splitlines()[-1]))
@@ -649,21 +731,24 @@ def run_bench(deadline, attempt=0, platform=None):
     #  the delta is the analog of the CPU-vs-GPU AUC table)
     try:
         if deadline() > 150 and not headline_only:
-            n_small = 400_000 if platform != "cpu" else 50_000
-            n_small = min(n_small, n_rows)
-            Xs, ys = X[:n_small], y[:n_small]
-            small = dict(params, num_leaves=63, metric="none")
-            b_wave = lgb.train(small, lgb.Dataset(Xs, label=ys),
-                               num_boost_round=15)
-            b_exact = lgb.train(dict(small, tpu_wave_size=1),
-                                lgb.Dataset(Xs, label=ys), num_boost_round=15)
-            auc_w = _auc(yt, b_wave.predict(Xt))
-            auc_e = _auc(yt, b_exact.predict(Xt))
-            gap = abs(auc_w - auc_e)
-            result["auc_parity_gap"] = round(gap, 6)
-            # reference GPU parity band: |CPU - GPU| AUC deltas are
-            # ~3e-5..1e-3 (docs/GPU-Performance.rst:135-159); 2e-3 @ 15 iters
-            result["auc_parity_ok"] = bool(gap < 2e-3)
+            with _phase_watchdog("parity", min(deadline() - 40, 420)):
+                n_small = 400_000 if platform != "cpu" else 50_000
+                n_small = min(n_small, n_rows)
+                Xs, ys = X[:n_small], y[:n_small]
+                small = dict(params, num_leaves=63, metric="none")
+                b_wave = lgb.train(small, lgb.Dataset(Xs, label=ys),
+                                   num_boost_round=15)
+                b_exact = lgb.train(dict(small, tpu_wave_size=1),
+                                    lgb.Dataset(Xs, label=ys),
+                                    num_boost_round=15)
+                auc_w = _auc(yt, b_wave.predict(Xt))
+                auc_e = _auc(yt, b_exact.predict(Xt))
+                gap = abs(auc_w - auc_e)
+                result["auc_parity_gap"] = round(gap, 6)
+                # reference GPU parity band: |CPU - GPU| AUC deltas are
+                # ~3e-5..1e-3 (docs/GPU-Performance.rst:135-159); 2e-3 @ 15
+                # iters
+                result["auc_parity_ok"] = bool(gap < 2e-3)
     except BenchTimeout:
         raise
     except Exception as e:                                   # noqa: BLE001

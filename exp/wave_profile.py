@@ -7,7 +7,14 @@ Measures on the real chip (N=2.1M, F=28, B=256, S=16 — the BENCH_r02 regime):
   3. compacted histogram at several n_active fractions, both kernels
   4. compact_rows alone
   5. split scan for 2S slots
-  6. grow_tree end-to-end, xla vs pallas, varying (row_compact, slots)
+  6. grow_tree end-to-end, xla vs pallas, varying (row_compact, slots,
+     incremental_partition)
+  7. per-wave FIXED costs, legacy vs incremental partition: the full-N
+     bookkeeping a wave pays BEFORE any histogram work (slot lookup +
+     stable argsort + [N,S] counts on the legacy path; the cumsum
+     counting-sort partition update + routing-table lookup on the
+     incremental path) next to the histogram matmul they gate — so the
+     next round's profile attributes the wave loop, not just the kernels
 
 Run: python -u exp/wave_profile.py [quick]   (prints incrementally)
 """
@@ -19,7 +26,8 @@ import jax
 import jax.numpy as jnp
 
 from lightgbm_tpu.grower import GrowerSpec, grow_tree
-from lightgbm_tpu.ops.histogram import build_histograms, compact_rows
+from lightgbm_tpu.ops.histogram import (build_histograms, compact_rows,
+                                        table_lookup)
 from lightgbm_tpu.ops.pallas_histogram import build_histograms_pallas
 from lightgbm_tpu.ops.split_finder import per_feature_best_numerical
 
@@ -125,21 +133,99 @@ t = timeit(jax.jit(lambda hh: per_feature_best_numerical(
 report(f"5. split scan 2S={2*S} slots", t)
 
 # ---- 6. grow_tree end-to-end ------------------------------------------------
-configs = [("xla", True, 16), ("pallas", True, 16), ("xla", False, 16),
-           ("pallas", False, 16)]
+# (kernel, row_compact, slots, incremental_partition) — the inc=0 arms are
+# the legacy per-wave argsort rebuild, the round-6 A/B of the tentpole
+configs = [("xla", True, 16, True), ("xla", True, 16, False),
+           ("mixed", True, 16, True),
+           ("pallas", True, 16, True), ("xla", False, 16, True),
+           ("pallas", False, 16, True)]
 if not quick:
-    configs += [("pallas", True, 25), ("pallas", False, 25)]
-for kern, rc, slots in configs:
+    configs += [("xla", True, 25, True), ("xla", True, 25, False),
+                ("mixed", True, 25, True),
+                ("pallas", True, 25, True), ("pallas", False, 25, True)]
+for kern, rc, slots, inc_part in configs:
     spec = GrowerSpec(num_leaves=L, num_features=F, num_bins_padded=B,
-                      chunk_rows=chunk if kern == "xla" else 2048,
+                      chunk_rows=chunk if kern != "pallas" else 2048,
                       hist_slots=slots, wave_size=slots,
                       max_depth=0, lambda_l1=0.0, lambda_l2=0.0,
                       min_data_in_leaf=100.0, min_sum_hessian_in_leaf=1e-3,
-                      min_gain_to_split=0.0, row_compact=rc, hist_kernel=kern)
+                      min_gain_to_split=0.0, row_compact=rc, hist_kernel=kern,
+                      incremental_partition=inc_part)
     grow = jax.jit(lambda gg, spec=spec: grow_tree(
         Xd, gg, h, inc, fok, is_cat, num_bins, missing_code, default_bin,
         spec))
     t = timeit(grow, g, reps=3)
-    report(f"6. grow_tree {kern:<6} compact={int(rc)} slots={slots}", t)
+    report(f"6. grow_tree {kern:<6} compact={int(rc)} slots={slots} "
+           f"inc={int(inc_part)}", t)
     thr = N / t / 1e6
     print(f"   -> {thr:6.1f} Mrow-tree/s (baseline 22.0)", flush=True)
+
+# ---- 7. per-wave FIXED costs: legacy vs incremental partition ---------------
+# What a wave pays in bookkeeping BEFORE/BESIDE the histogram matmul. The
+# legacy path pays (a)+(b) on EVERY compacted wave; the incremental path
+# pays (c) once per wave inside routing (which already runs) plus O(S)
+# segment-table reads. Compare each against the compacted hist pass above.
+W = 16   # splits applied in the simulated wave
+
+# (a) legacy: full-N slot lookup (the per-wave table_lookup the incremental
+#     path deleted — slot_counts now come from carried segment tables)
+t = timeit(jax.jit(lambda lid: table_lookup(lid, slot_all)), leaf_id)
+report("7a. legacy slot lookup: table_lookup(leaf_id)", t)
+
+# (b) legacy: stable argsort + [N,S] compare-sum counts (the per-wave
+#     compaction rebuild)
+def legacy_rebuild(lid):
+    sr = table_lookup(lid, slot_all)
+    key = jnp.where(sr >= 0, sr, jnp.int32(2 ** 30))
+    ri = jnp.argsort(key, stable=True).astype(jnp.int32)
+    counts = jnp.sum((sr[:, None] == jnp.arange(S, dtype=jnp.int32)[None, :])
+                     .astype(jnp.int32), axis=0)
+    return ri, counts
+t = timeit(jax.jit(legacy_rebuild), leaf_id)
+report("7b. legacy compaction rebuild: argsort + [N,S] counts", t)
+
+# (c) incremental: the counting-sort partition update (cumsums + integer
+#     one-hot bases + one monotonic scatter), fed by a routing-shaped
+#     go_left/k_row pair — the ONLY full-N bookkeeping a wave retains.
+#     Layout is SELF-CONSISTENT (perm leaf-grouped, segments from real
+#     counts, splits at leaves 0..W-1) so the scatter is a true partition
+#     update, not just a same-shape op.
+perm0 = jnp.asarray(np.argsort(leaf_id_np, kind="stable").astype(np.int32))
+_cnts = np.bincount(leaf_id_np, minlength=L + 1).astype(np.int32)
+_starts = np.zeros(L + 1, np.int32)
+_starts[1:] = np.cumsum(_cnts)[:-1]
+seg_start = jnp.asarray(_starts)
+seg_rows = jnp.asarray(_cnts)
+k_row_sim = jnp.where(leaf_id < W, leaf_id, -1)
+go_left_sim = jnp.asarray(rng.rand(N) < 0.5)
+
+def inc_update(k_row, go_left, perm):
+    code_row = jnp.where(k_row >= 0, 2 * k_row + jnp.where(go_left, 0, 1), -1)
+    code_pos = jnp.take(code_row, perm)
+    left_pos = (code_pos >= 0) & ((code_pos & 1) == 0)
+    right_pos = (code_pos >= 0) & ((code_pos & 1) == 1)
+    k_pos = code_pos >> 1
+    cl = jnp.cumsum(left_pos.astype(jnp.int32))
+    cr = jnp.cumsum(right_pos.astype(jnp.int32))
+    cl0 = jnp.concatenate([jnp.zeros(1, jnp.int32), cl])
+    cr0 = jnp.concatenate([jnp.zeros(1, jnp.int32), cr])
+    p = jnp.arange(W, dtype=jnp.int32)
+    start_k = seg_start[p]
+    n_k = seg_rows[p]
+    clb = jnp.take(cl0, start_k)
+    crb = jnp.take(cr0, start_k)
+    nL = jnp.take(cl0, start_k + n_k) - clb
+    k_onehot = k_pos[:, None] == jnp.arange(W, dtype=jnp.int32)[None, :]
+    bl = jnp.sum(k_onehot * (start_k - clb)[None, :], axis=1)
+    br = jnp.sum(k_onehot * (start_k + nL - crb)[None, :], axis=1)
+    newpos = jnp.where(left_pos, (cl - left_pos.astype(jnp.int32)) + bl,
+                       (cr - right_pos.astype(jnp.int32)) + br)
+    return perm.at[jnp.where(code_pos >= 0, newpos, N)].set(perm, mode="drop")
+t = timeit(jax.jit(inc_update), k_row_sim, go_left_sim, perm0)
+report("7c. incremental partition update (cumsum sort)", t)
+
+# (d) routing table lookup — shared by BOTH paths (the one full-N lookup a
+#     wave keeps; the incremental path derives its split ordinals from it)
+route_table = jnp.zeros((L + 1, 6), jnp.int32).at[:, 0].set(-1)
+t = timeit(jax.jit(lambda lid: table_lookup(lid, route_table)), leaf_id)
+report("7d. routing table_lookup [N,6] (both paths)", t)

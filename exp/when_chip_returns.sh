@@ -28,8 +28,9 @@ LGBM_TPU_BENCH_ROWS=2100000 LGBM_TPU_BENCH_SPARSE=0 \
   LGBM_TPU_BENCH_TIMEOUT=900 timeout 1000 \
   python bench.py | tee exp/BENCH_local_r5_quick.json
 echo "=== 2. pallas equality ON-CHIP (per-shape gate; writes the trust"
-echo "       marker the explicit pallas/mixed knobs consult — auto always"
-echo "       resolves xla; exit 0 just means SOME shape validated) ==="
+echo "       marker tpu_hist_kernel=auto consults — a validated shape"
+echo "       class flips auto to the MIXED dispatch on later runs; exit 0"
+echo "       just means SOME shape validated) ==="
 rm -f exp/PALLAS_ONCHIP_OK
 if timeout 1200 python -u exp/pallas_onchip_check.py; then
   touch exp/PALLAS_ONCHIP_OK
@@ -37,8 +38,8 @@ if timeout 1200 python -u exp/pallas_onchip_check.py; then
 else
   echo "PALLAS GATE: nothing validated (auto stays xla)"
 fi
-echo "=== 3. full bench (10.5M; auto always resolves xla — gated shapes"
-echo "       only matter for the explicit LGBM_TPU_BENCH_KERNEL runs) ==="
+echo "=== 3. full bench (10.5M; auto resolves MIXED iff step 2 gated the"
+echo "       headline shape class on this machine, xla otherwise) ==="
 LGBM_TPU_BENCH_TIMEOUT=2700 timeout 2900 python bench.py | tee exp/BENCH_local_r5.json
 if [ -f exp/PALLAS_ONCHIP_OK ]; then
   echo "=== 4. full bench kernel=mixed (explicit gated kernel, comparison"

@@ -6,6 +6,7 @@ from .dtype_promotion import DtypePromotionRule    # R003
 from .pallas_shapes import PallasShapeRule         # R004
 from .static_args import StaticArgsRule            # R005
 from .import_exec import ImportExecRule            # R006
+from .sort_in_loop import SortInLoopRule           # R007
 
 _RULES = None
 
@@ -14,5 +15,6 @@ def active_rules():
     global _RULES
     if _RULES is None:
         _RULES = [ControlFlowRule(), HostSyncRule(), DtypePromotionRule(),
-                  PallasShapeRule(), StaticArgsRule(), ImportExecRule()]
+                  PallasShapeRule(), StaticArgsRule(), ImportExecRule(),
+                  SortInLoopRule()]
     return _RULES

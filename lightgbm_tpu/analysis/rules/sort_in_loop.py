@@ -1,0 +1,116 @@
+"""R007: full-array argsort/sort inside a lax.while_loop body.
+
+A sort inside the device-side wave loop is a per-iteration fixed cost the
+whole loop pays on every trip — the exact failure class the incremental
+leaf partition removed from the grower (a full-N stable argsort per wave at
+the 10.5M-row bench; grower.py GrowState.perm replaces it with cumsum
+counting-sort maintenance). New sorts must not creep back into loop bodies:
+slot grouping derives from carried per-leaf segment tables, compaction from
+prefix sums + monotonic scatters (ops/histogram.py compact_rows /
+slot_position_base).
+
+Detection is an intra-module reachability walk: functions passed to
+``lax.while_loop`` (by name or inline lambda) are roots; any same-file
+function they reference — called directly, or passed onward to e.g.
+``lax.cond`` — is reachable; a ``jnp.argsort``/``jnp.sort``/``jnp.lexsort``/
+``lax.sort``/``lax.sort_key_val`` call in reachable code fires. Cross-module
+calls are invisible to the AST pass (documented limitation); the audited
+intentional site — the grower's LEGACY compact path, kept as the
+bit-identity pin for ``tpu_incremental_partition=false`` — lives in the
+committed baseline.
+"""
+from __future__ import annotations
+
+import ast
+
+from .common import dotted_name
+
+RULE_ID = "R007"
+
+_WHILE_LOOP = {"jax.lax.while_loop", "lax.while_loop"}
+_SORT_CALLS = {
+    "jnp.argsort", "jnp.sort", "jnp.lexsort",
+    "jax.numpy.argsort", "jax.numpy.sort", "jax.numpy.lexsort",
+    "jax.lax.sort", "lax.sort",
+    "jax.lax.sort_key_val", "lax.sort_key_val",
+}
+
+
+def _local_defs(tree):
+    """Every function def in the module (nested included), by name.
+
+    Name collisions keep the FIRST def — conservative for a lint heuristic;
+    the reachability walk only follows names, never instances."""
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    return defs
+
+
+def _referenced_names(fn):
+    """Names a function loads anywhere in its body — covers direct calls
+    AND functions passed as arguments (``lax.cond(pred, compact_pass, ...)``
+    reaches ``compact_pass`` without a Call node naming it)."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            out.add(node.id)
+    return out
+
+
+class SortInLoopRule:
+    rule_id = RULE_ID
+    summary = ("argsort/sort reachable from a lax.while_loop body — a "
+               "per-iteration fixed cost; use the carried incremental "
+               "partition / prefix-sum compaction instead")
+
+    def check(self, ctx):
+        defs = _local_defs(ctx.tree)
+
+        # roots: callables handed to while_loop (positional or cond=/body=)
+        roots = []          # FunctionDef or Lambda nodes
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in _WHILE_LOOP:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    roots.append(arg)
+                else:
+                    name = dotted_name(arg)
+                    if name in defs:
+                        roots.append(defs[name])
+        if not roots:
+            return
+
+        # reachability over same-file defs via loaded names
+        reachable, frontier = [], list(roots)
+        seen = set()
+        while frontier:
+            fn = frontier.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            reachable.append(fn)
+            for name in _referenced_names(fn):
+                target = defs.get(name)
+                if target is not None and id(target) not in seen:
+                    frontier.append(target)
+
+        reported = set()
+        for fn in reachable:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and dotted_name(node.func) in _SORT_CALLS \
+                        and id(node) not in reported:
+                    reported.add(id(node))
+                    where = getattr(fn, "name", "<lambda>")
+                    yield ctx.finding(
+                        self.rule_id, node,
+                        f"`{dotted_name(node.func)}` reachable from a "
+                        f"lax.while_loop body (via `{where}`) — sorts are "
+                        f"per-iteration fixed costs; derive grouping from "
+                        f"carried state (incremental partition) or "
+                        f"prefix-sum compaction")

@@ -47,6 +47,11 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+# one-time (per process) EFB-on-TPU throughput warning — the measured loss
+# is per-workload, not per-booster, so repeating it per construction is noise
+_EFB_TPU_WARNED = [False]
+
+
 class ValidSet(MetadataDuckTyping):
     # the mixin supplies the duck-typed Dataset surface so user fevals
     # written against the reference python-package contract keep working
@@ -212,17 +217,34 @@ class GBDT:
                     Log.info("EFB: %d features bundled into %d columns "
                              "(%d max bundle bins)", F, plan.num_groups,
                              plan.max_bundle_bins)
+                    if (self.pctx.devices[0].platform == "tpu"
+                            and not _EFB_TPU_WARNED[0]):
+                        # round-5 on-chip measurement (exp/HARVEST_r5.jsonl,
+                        # docs/TPU-Performance.md): the Bosch-shaped bench
+                        # ran at 1.1 Mrow-tree/s WITH EFB vs 3.8 without —
+                        # the per-row bundle decode in routing/unpack
+                        # dominates the wave on TPU even though the matmul
+                        # shrinks. EFB still wins on HBM footprint.
+                        _EFB_TPU_WARNED[0] = True
+                        Log.warning(
+                            "EFB engaged on the TPU backend: round-5 "
+                            "measured a 3.5x throughput LOSS on the "
+                            "Bosch-shaped benchmark (1.1 vs 3.8 "
+                            "Mrow-tree/s — bundle decode dominates; "
+                            "docs/TPU-Performance.md). Set "
+                            "enable_bundle=false unless HBM footprint is "
+                            "the constraint")
 
         # ---- histogram kernel choice (needs the FINAL kernel shape class,
-        #      hence after EFB planning). "auto" ALWAYS resolves to the XLA
-        #      one-hot matmul — the round-5 measured end-to-end best (see the
-        #      resolution block below). pallas/mixed are explicit opt-in
-        #      knobs; the on-chip gate (exp/pallas_onchip_check.py — the
-        #      analog of the reference's GPU_DEBUG_COMPARE,
-        #      gpu_tree_learner.cpp:1018-1043) records a per-shape-class
-        #      TRUST marker for them (Mosaic lowering failures are
-        #      shape-triggered, round-5 gate log), consulted below to warn
-        #      when an explicit pallas/mixed run hits an un-gated shape.
+        #      hence after EFB planning). "auto" resolves to the MIXED
+        #      dispatch (XLA streaming passes, pallas-512 compacted passes —
+        #      the round-5 pass-level measured best) on a real TPU whose
+        #      on-chip gate (exp/pallas_onchip_check.py — the analog of the
+        #      reference's GPU_DEBUG_COMPARE, gpu_tree_learner.cpp:1018-1043)
+        #      has validated THIS kernel shape class, and to the XLA one-hot
+        #      matmul everywhere else (Mosaic lowering failures are
+        #      shape-triggered, round-5 gate log). Explicit pallas/mixed on
+        #      an un-gated shape still runs, with the warning below.
         # auto slots: 25 x 5 bf16 channels = 125 matmul columns — one full
         # MXU tile (128) — while quartering the wave count at 255 leaves.
         # User-set slot counts clamp to the leaf budget: the wave loop's
@@ -240,20 +262,40 @@ class GBDT:
         else:
             cols_pad = F_pad
         chunk = min(config.tpu_hist_chunk, _round_up(per_target, 256))
+        # ONE kernel shape-class key, shared by every gate consult below
+        # (the auto->mixed resolution AND the explicit pallas/mixed warning):
+        # two hand-synced constructions would let auto trust a different
+        # shape class than the one the warning path checks — exactly the
+        # Mosaic-failure class the gate exists to prevent.
+        from ..utils.cache import pallas_config_key, pallas_validated_on_chip
+        _kernel_dtype = (bundle_plan.X_bundled.dtype
+                         if bundle_plan is not None
+                         else train_set.X_binned.dtype)
+        _kernel_bins = Bb_pad if bundle_plan is not None else Bpad
+        pallas_shape_key = pallas_config_key(
+            int(np.dtype(_kernel_dtype).itemsize), _kernel_bins,
+            slots, cols_pad, 5 if config.tpu_hist_hilo else 3)
         hist_kernel = config.tpu_hist_kernel
         if hist_kernel == "auto":
-            # Round-5 end-to-end measurements picked XLA: at the pass
-            # level the pallas kernel only wins compacted passes near 25%
-            # active (18.0 vs 22.1 ms), but real trees compact at 3-12%
-            # active where its fixed-size skip-grid loses to the XLA
-            # path's dynamic trip count — grow_tree: xla 263 ms, mixed
-            # 286, all-pallas 306 (exp/RESULTS.md round-5 session). auto
-            # therefore resolves xla; pallas/mixed remain explicit knobs
-            # whose trusted shapes the per-config on-chip gate still
-            # records (exp/pallas_onchip_check.py, utils/cache.py).
+            # Round-5 pass-level shootout (exp/kern_bench_r5.py): pallas-512
+            # wins COMPACTED passes (18.0 vs 22.1 ms at 25% active) while
+            # the XLA one-hot matmul wins full streaming passes (33.7 vs
+            # 39.4/55.0) — the measured-best dispatch is MIXED. With the
+            # incremental partition (grower.py) removing the per-wave
+            # argsort that used to tax every compacted pass, the compacted
+            # kernel drives the steady state, so auto now defaults to mixed
+            # — but ONLY where the on-chip equality gate has validated this
+            # exact kernel shape class on this machine/libtpu (Mosaic
+            # lowering failures are shape-triggered, round-5 gate log).
+            # Un-gated shape classes and non-TPU platforms keep plain xla.
             hist_kernel = "xla"
-            Log.debug("tpu_hist_kernel=auto resolved to xla (measured "
-                      "end-to-end best, round-5)")
+            if (not config.tpu_hist_f64
+                    and self.pctx.devices[0].platform == "tpu"
+                    and pallas_validated_on_chip(pallas_shape_key)):
+                hist_kernel = "mixed"
+            Log.debug("tpu_hist_kernel=auto resolved to %s%s", hist_kernel,
+                      " (on-chip gate validated this shape class)"
+                      if hist_kernel == "mixed" else "")
         if config.tpu_hist_f64 and hist_kernel in ("pallas", "mixed"):
             Log.warning("tpu_hist_f64 requires the xla histogram kernel; "
                         "overriding tpu_hist_kernel=%s", hist_kernel)
@@ -354,20 +396,18 @@ class GBDT:
         # shape-triggered, so the operator should know this exact shape
         # never executed on this machine's libtpu.
         if (hist_kernel in ("pallas", "mixed")
-                and self.pctx.devices[0].platform == "tpu"):
-            from ..utils.cache import (pallas_config_key,
-                                       pallas_validated_on_chip)
-            _ck = pallas_config_key(
-                int(np.dtype(Xb.dtype).itemsize), self._hist_bins or Bpad,
-                slots, cols_pad, 5 if config.tpu_hist_hilo else 3)
-            if not pallas_validated_on_chip(_ck):
-                Log.warning(
-                    "tpu_hist_kernel=%s: shape class %s has never passed "
-                    "the on-chip equality gate on this machine/libtpu "
-                    "(exp/pallas_onchip_check.py writes the trust marker) "
-                    "— Mosaic lowering failures are shape-triggered; run "
-                    "the gate or use tpu_hist_kernel=xla if results look "
-                    "wrong", hist_kernel, _ck)
+                and self.pctx.devices[0].platform == "tpu"
+                and not pallas_validated_on_chip(pallas_shape_key)):
+            # pallas_shape_key is the SAME key the auto->mixed resolution
+            # consulted above — one construction, so the trusted shape and
+            # the warned-about shape can never drift apart
+            Log.warning(
+                "tpu_hist_kernel=%s: shape class %s has never passed "
+                "the on-chip equality gate on this machine/libtpu "
+                "(exp/pallas_onchip_check.py writes the trust marker) "
+                "— Mosaic lowering failures are shape-triggered; run "
+                "the gate or use tpu_hist_kernel=xla if results look "
+                "wrong", hist_kernel, pallas_shape_key)
 
         # slots were fixed alongside the kernel choice (they are part of
         # the gated kernel shape class)
@@ -386,6 +426,7 @@ class GBDT:
             min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
             min_gain_to_split=config.min_gain_to_split,
             row_compact=config.tpu_row_compact,
+            incremental_partition=config.tpu_incremental_partition,
             compact_frac=config.tpu_compact_frac,
             hist_kernel=hist_kernel,
             hist_hilo=config.tpu_hist_hilo,

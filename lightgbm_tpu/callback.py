@@ -28,6 +28,12 @@ def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
     state = {"last": 0}
 
     def _callback(env: CallbackEnv) -> None:
+        if env.iteration + 1 < state["last"]:
+            # the callback object was reused across train() calls (common
+            # CV/fold loops): iterations restarted below the recorded
+            # crossing point, so reset — otherwise every later run logs
+            # nothing until it passes the previous run's last iteration
+            state["last"] = 0
         if (period > 0 and env.evaluation_result_list
                 and env.iteration + 1 - state["last"] >= period):
             state["last"] = env.iteration + 1

@@ -297,12 +297,23 @@ class Config:
     tpu_row_compact: bool = True
     tpu_compact_frac: float = 0.25            # compact passes below this
                                               # active-row fraction
-    # histogram kernel: "auto" (= xla, the round-5 measured end-to-end best;
-    # see boosting/gbdt.py kernel-resolution block) | "xla" one-hot matmul |
+    # incremental leaf partition (grower.py GrowState.perm — the reference's
+    # DataPartition, data_partition.hpp:94): the slot-grouped row permutation
+    # is maintained ACROSS waves by a cumsum-based stable counting-sort over
+    # the split leaves' segments, so the wave body carries no full-N stable
+    # argsort / [N,S] count reduction / slot table_lookup. false = the
+    # legacy per-wave argsort rebuild (bit-identical — the A/B + parity pin,
+    # tests/test_incremental_partition.py)
+    tpu_incremental_partition: bool = True
+    # histogram kernel: "auto" resolves to "mixed" (XLA streaming passes +
+    # pallas-512 compacted passes — the round-5 pass-level measured best,
+    # 18.0 vs 22.1 ms at 25% active) on a real TPU whose on-chip gate has
+    # validated this kernel shape class, and to "xla" everywhere else; see
+    # boosting/gbdt.py kernel-resolution block. "xla" one-hot matmul |
     # "pallas" fused VMEM-accumulator kernel (ops/pallas_histogram.py, the
     # OpenCL histogram256.cl analog) | "mixed" (pallas for compacted passes
-    # only). pallas/mixed are explicit opt-ins whose trusted shape classes
-    # the on-chip gate records (exp/pallas_onchip_check.py)
+    # only). Explicit pallas/mixed on a never-gated shape class runs with a
+    # warning (exp/pallas_onchip_check.py records the trust markers)
     tpu_hist_kernel: str = "auto"
     # per-phase wall-clock accumulators (reference TIMETAG) printed after
     # training; tpu_profile_dir wraps training in a jax.profiler trace

@@ -4,8 +4,13 @@ TPU re-architecture of SerialTreeLearner::Train
 (reference: src/treelearner/serial_tree_learner.cpp:152-231):
 
 - The reference's per-leaf DataPartition (permuted row indices,
-  data_partition.hpp) becomes a flat `leaf_id[num_rows]` vector — no row
-  movement, ever.
+  data_partition.hpp) appears TWICE: a flat `leaf_id[num_rows]` vector
+  drives routing/score updates, and (row_compact) a leaf-contiguous row
+  permutation is carried across waves (GrowState.perm + per-leaf segment
+  tables) exactly like the reference's — after a wave's splits only the
+  split leaves' segments move, via a stable cumsum counting-sort, never a
+  sort op; compacted histogram passes gather pending segments through a
+  per-chunk position remap (ops/histogram.py slot_position_base).
 - The reference's one-split-per-iteration loop with histogram pool becomes a
   `lax.while_loop` over *waves*: each wave builds histograms for all pending
   leaves in ONE masked matmul pass (ops/histogram.py), finds their best splits
@@ -119,6 +124,20 @@ class GrowState(NamedTuple):
     parent_cache: jnp.ndarray     # i32 [L+1] cache row holding the parent hist
     num_leaves_cur: jnp.ndarray   # i32
     done: jnp.ndarray             # bool
+    # Incremental leaf partition (the reference's DataPartition,
+    # data_partition.hpp:94, maintained ACROSS waves): rows of leaf l occupy
+    # positions [seg_start[l], seg_start[l] + seg_rows[l]) of `perm`, in
+    # ascending original row order — stable splits preserve that order, so
+    # the compacted gather sequence is BIT-identical to the legacy per-wave
+    # stable-argsort path. seg_rows are RAW row counts (OOB/padding rows
+    # included; they route but carry zero weights), distinct from the
+    # bagging-weighted `cnt`. All three are None when the incremental
+    # partition is off (row_compact=false or tpu_incremental_partition=
+    # false) — None is a static empty pytree leaf, so the while_loop carry
+    # stays structurally consistent.
+    perm: Optional[jnp.ndarray] = None       # i32 [N] leaf-contiguous rows
+    seg_start: Optional[jnp.ndarray] = None  # i32 [L+1]
+    seg_rows: Optional[jnp.ndarray] = None   # i32 [L+1]
 
 
 @dataclass(frozen=True)
@@ -137,6 +156,17 @@ class GrowerSpec:
     min_sum_hessian_in_leaf: float
     min_gain_to_split: float
     row_compact: bool = True      # histogram only pending-leaf rows per wave
+    incremental_partition: bool = True
+                                  # maintain the leaf-contiguous row
+                                  # permutation ACROSS waves (GrowState.perm,
+                                  # the DataPartition analog): compacted
+                                  # passes read it through a per-chunk
+                                  # position remap and the per-wave full-N
+                                  # stable argsort + [N,S] count reduction +
+                                  # slot table_lookup disappear from the
+                                  # wave body. False = the legacy per-wave
+                                  # argsort rebuild (bit-identical, pinned
+                                  # by tests/test_incremental_partition.py)
     compact_frac: float = 0.25    # compact when n_active < frac*N. The
                                   # round-5 trace put the hist matmul at 92%
                                   # MXU peak, so the remaining lever is the
@@ -322,6 +352,12 @@ def grow_tree(
     else:
         packed_rows = None
 
+    # incremental partition (tentpole): rows start as ONE root segment in
+    # original order — the identity permutation, rebuilt per tree (iota is
+    # free; a cross-tree carry would violate the ascending-within-segment
+    # invariant the root segment needs)
+    use_inc = spec.row_compact and spec.incremental_partition
+
     tree = _empty_tree(L, B)
     state = GrowState(
         tree=tree,
@@ -338,6 +374,10 @@ def grow_tree(
         parent_cache=jnp.full(L + 1, L, jnp.int32),
         num_leaves_cur=jnp.asarray(1, jnp.int32),
         done=jnp.asarray(False),
+        perm=jnp.arange(N, dtype=jnp.int32) if use_inc else None,
+        seg_start=jnp.zeros(L + 1, jnp.int32) if use_inc else None,
+        seg_rows=(jnp.zeros(L + 1, jnp.int32).at[0].set(N)
+                  if use_inc else None),
     )
 
     leaf_iota = jnp.arange(L + 1, dtype=jnp.int32)
@@ -356,7 +396,7 @@ def grow_tree(
         # then the distributed reduction: psum_scatter for data-parallel
         # (reference data_parallel_tree_learner.cpp:148-163), identity
         # otherwise; output covers this device's feature block only.
-        def hist_pass(row_idx, n_active, slot_counts=None):
+        def hist_pass(row_idx, n_active, slot_counts=None, slot_starts=None):
             # "mixed" (the round-5 measured-best dispatch): the XLA one-hot
             # matmul for FULL streaming passes (33.7 ms vs pallas 55/39 at
             # 2M rows) and the Pallas VMEM-accumulator kernel for COMPACTED
@@ -374,7 +414,8 @@ def grow_tree(
                     chunk_rows=min(spec.chunk_rows, 512),
                     row_idx=row_idx,
                     n_active=n_active, hilo=spec.hist_hilo,
-                    slot_counts=slot_counts, packed=packed_rows,
+                    slot_counts=slot_counts, slot_starts=slot_starts,
+                    packed=packed_rows,
                     # the adaptive cond only takes this path when
                     # n_active*4 < N — grid + buffers shrink to match
                     max_rows=(N + 3) // 4)
@@ -382,23 +423,45 @@ def grow_tree(
                 X_hist, grad, hess, included, state.leaf_id, slot_of_leaf,
                 num_slots=S, num_bins_padded=B_hist, chunk_rows=spec.chunk_rows,
                 row_idx=row_idx, n_active=n_active, hilo=wmode,
-                slot_counts=slot_counts, packed=packed_rows,
+                slot_counts=slot_counts, slot_starts=slot_starts,
+                packed=packed_rows,
                 code_mode=spec.code_mode, compensated=spec.hist_f64)
 
         if spec.row_compact:
-            # Adaptive: a compacted pass pays one stable argsort plus a
-            # random row gather per active row (~2.5x the per-row cost of the
-            # streaming masked pass), so it only wins when few rows are
-            # active. Measured breakeven on v5e is ~25% active
-            # (exp/chain_profile.py); early waves (incl. the root) therefore
-            # run the full masked pass, late waves the compacted one — the
-            # TPU analog of the reference histogramming only the smaller
-            # leaf's rows (serial_tree_learner.cpp:354-362).
-            slot_row = table_lookup(state.leaf_id, slot_of_leaf)  # [N] i32
-            n_active = jnp.sum((slot_row >= 0).astype(jnp.int32))
+            # Adaptive: a compacted pass pays one random row gather per
+            # active row (~2.5x the per-row cost of the streaming masked
+            # pass), so it only wins when few rows are active. Measured
+            # breakeven on v5e is ~25% active (exp/chain_profile.py); early
+            # waves (incl. the root) therefore run the full masked pass,
+            # late waves the compacted one — the TPU analog of the reference
+            # histogramming only the smaller leaf's rows
+            # (serial_tree_learner.cpp:354-362).
+            if use_inc:
+                # slot bookkeeping straight from the carried partition:
+                # counts/starts are [S]-sized gathers from the per-leaf
+                # segment tables, n_active a [S] reduction — the per-wave
+                # full-N table_lookup + compare-sum + stable argsort of the
+                # legacy path all disappear from the wave body.
+                # leaf_of_slot == L for empty slots and seg_rows[L] stays 0,
+                # so invalid slots contribute nothing.
+                slot_counts_inc = state.seg_rows[leaf_of_slot]        # [S]
+                slot_starts_inc = state.seg_start[leaf_of_slot]       # [S]
+                n_active = jnp.sum(slot_counts_inc)
+            else:
+                slot_row = table_lookup(state.leaf_id, slot_of_leaf)  # [N] i32
+                n_active = jnp.sum((slot_row >= 0).astype(jnp.int32))
 
             def compact_pass():
-                # rows grouped by slot, original order within a slot (stable)
+                if use_inc:
+                    # rows already slot-grouped inside the carried
+                    # permutation; the kernels map compacted positions into
+                    # the pending segments via slot_starts (active chunks
+                    # only — steady-state waves never touch inactive rows)
+                    return hist_pass(state.perm, n_active, slot_counts_inc,
+                                     slot_starts_inc)
+                # legacy rebuild: rows grouped by slot, original order
+                # within a slot (stable) — kept as the A/B + parity pin for
+                # the incremental path (tpu_incremental_partition=false)
                 key = jnp.where(slot_row >= 0, slot_row, jnp.int32(2 ** 30))
                 row_idx = jnp.argsort(key, stable=True).astype(jnp.int32)
                 counts = jnp.sum(
@@ -583,10 +646,67 @@ def grow_tree(
             go_left = jnp.where(cat_row, go_left_cat, go_left)
         leaf_id = jnp.where((f_row >= 0), jnp.where(go_left, lid, right_row), lid)
 
+        # ---- 8. incremental partition maintenance --------------------------
+        # The reference's DataPartition::Split (data_partition.hpp:94): only
+        # the split leaves' segments re-partition — STABLY, via the same
+        # prefix-sum + monotonic-scatter machinery as compact_rows
+        # (ops/histogram.py:251), never a sort. Leaf p keeps the front of
+        # its old segment (its go-left rows, original order), new leaf q
+        # takes the back — so within-segment ascending row order survives
+        # and the next wave's compacted gather sequence is bit-identical to
+        # the legacy stable-argsort path. All bookkeeping piggybacks on the
+        # routing pass above: the split ordinal of a row's leaf is recovered
+        # from the SAME table_lookup output (q = num_leaves_cur + srank), so
+        # no extra per-row lookup runs.
+        if use_inc:
+            k_row = jnp.where(f_row >= 0,
+                              right_row - state.num_leaves_cur, -1)   # [N]
+            code_row = jnp.where(f_row >= 0,
+                                 2 * k_row + jnp.where(go_left, 0, 1), -1)
+            code_pos = jnp.take(code_row, state.perm)      # row -> position
+            in_split = code_pos >= 0
+            left_pos = in_split & ((code_pos & 1) == 0)
+            right_pos = in_split & ((code_pos & 1) == 1)
+            k_pos = code_pos >> 1                          # -1 stays -1
+            cl = jnp.cumsum(left_pos.astype(jnp.int32))    # inclusive
+            cr = jnp.cumsum(right_pos.astype(jnp.int32))
+            # cl0[j] = lefts strictly before position j (length N+1 so the
+            # one-past-the-end segment boundary reads the segment total)
+            cl0 = jnp.concatenate([jnp.zeros(1, jnp.int32), cl])
+            cr0 = jnp.concatenate([jnp.zeros(1, jnp.int32), cr])
+            start_k = state.seg_start[p]                   # [S]; p==L inert
+            n_k = state.seg_rows[p]
+            clb = jnp.take(cl0, start_k)
+            crb = jnp.take(cr0, start_k)
+            nL = jnp.take(cl0, start_k + n_k) - clb        # raw left rows
+            # per-slot additive bases resolved per position by an INTEGER
+            # one-hot multiply-sum (exact at any N — no f32 2^24 ceiling)
+            k_onehot = (k_pos[:, None]
+                        == jnp.arange(S, dtype=jnp.int32)[None, :])
+            base_l = jnp.sum(k_onehot * (start_k - clb)[None, :], axis=1)
+            base_r = jnp.sum(k_onehot * (start_k + nL - crb)[None, :], axis=1)
+            newpos = jnp.where(left_pos,
+                               (cl - left_pos.astype(jnp.int32)) + base_l,
+                               (cr - right_pos.astype(jnp.int32)) + base_r)
+            perm = state.perm.at[jnp.where(in_split, newpos, N)].set(
+                state.perm, mode="drop")
+            seg_start = state.seg_start.at[q].set(start_k + nL)
+            seg_rows = state.seg_rows.at[p].set(nL).at[q].set(n_k - nL)
+            # scratch leaf L must stay an empty segment (slot_counts reads
+            # seg_rows[leaf_of_slot] with leaf_of_slot==L for empty slots);
+            # masked-split writes above land there and are reset like the
+            # tree table's scratch row
+            seg_start = seg_start.at[L].set(0)
+            seg_rows = seg_rows.at[L].set(0)
+        else:
+            perm, seg_start, seg_rows = (state.perm, state.seg_start,
+                                         state.seg_rows)
+
         done = (n_apply == 0) | (state.num_leaves_cur + n_apply >= L)
         return GrowState(t, leaf_id, hist, sum_g, sum_h, cnt, leaf_depth,
                          leaf_is_right, cand, needs_hist, sib_leaf, parent_cache,
-                         state.num_leaves_cur + n_apply, done)
+                         state.num_leaves_cur + n_apply, done,
+                         perm, seg_start, seg_rows)
 
     def cond(state: GrowState):
         return ~state.done

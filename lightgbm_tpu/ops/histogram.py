@@ -199,6 +199,26 @@ def slot_from_position(pos: jnp.ndarray, slot_cum: jnp.ndarray) -> jnp.ndarray:
                    axis=1)
 
 
+def slot_position_base(raw_slot: jnp.ndarray, slot_cum: jnp.ndarray,
+                       slot_starts: jnp.ndarray) -> jnp.ndarray:
+    """Additive base mapping a slot-grouped virtual position into a
+    leaf-contiguous permutation: ``src = pos + base[raw_slot]``.
+
+    The grower's incremental partition (grower.py GrowState.perm) keeps each
+    pending leaf's rows contiguous at ``slot_starts[s]`` instead of
+    materializing a compacted prefix; compacted histogram chunks translate
+    their positions on the fly, so only ACTIVE chunks ever touch the
+    permutation. Integer one-hot multiply-sum: exact at any N (no f32 2^24
+    ceiling) and no per-row table gather. Positions past the last slot
+    (raw_slot == S, garbage masked downstream) get base 0."""
+    S = slot_cum.shape[0]
+    cum_before = jnp.concatenate(
+        [jnp.zeros(1, slot_cum.dtype), slot_cum[:-1]])
+    base = slot_starts - cum_before                                 # [S]
+    onehot = raw_slot[:, None] == jnp.arange(S, dtype=jnp.int32)[None, :]
+    return jnp.sum(onehot * base[None, :], axis=1)
+
+
 def table_lookup(idx: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
     """table[idx] for a SMALL table ([T<=1024, C]) as a one-hot f32 matmul.
 
@@ -286,6 +306,12 @@ def build_histograms(
     slot_counts: jnp.ndarray = None,  # [S] i32: rows per slot when row_idx is
                                    # SLOT-GROUPED — slots derive from position
                                    # (2 fewer random gathers per active row)
+    slot_starts: jnp.ndarray = None,  # [S] i32: row_idx is a LEAF-CONTIGUOUS
+                                   # permutation (grower incremental
+                                   # partition) — slot s's rows live at
+                                   # row_idx[slot_starts[s]:...+counts[s]];
+                                   # chunks remap positions via
+                                   # slot_position_base. Requires slot_counts
     packed: jnp.ndarray = None,    # pre-built pack_rows(X, grad, hess,
                                    # included) — pass to amortize the O(N)
                                    # pack across waves of one tree
@@ -309,6 +335,8 @@ def build_histograms(
     n_chunks = n_rows // chunk_rows
     ch = num_channels(hilo)
     compact = row_idx is not None
+    assert slot_starts is None or slot_counts is not None, \
+        "slot_starts (leaf-contiguous row_idx) needs slot_counts"
     iota_bins = jnp.arange(num_bins_padded, dtype=jnp.int32)[None, None, :]
     iota_slots = jnp.arange(num_slots, dtype=jnp.int32)[None, :]
     iota_chunk = jnp.arange(chunk_rows, dtype=jnp.int32)
@@ -323,16 +351,24 @@ def build_histograms(
     def chunk_part(i):
         sl = jax.lax.dynamic_slice_in_dim
         if compact:
-            idx = sl(row_idx, i * chunk_rows, chunk_rows)
             pos = i * chunk_rows + iota_chunk
             valid = pos < n_active
+            if slot_starts is not None:
+                # leaf-contiguous permutation: translate compacted positions
+                # into the pending segments (incremental partition) — the
+                # slot is position-derived exactly as in the prefix layout
+                raw = slot_from_position(pos, slot_cum)
+                src = pos + slot_position_base(raw, slot_cum, slot_starts)
+                idx = jnp.take(row_idx, jnp.clip(src, 0, n_rows - 1))
+            else:
+                idx = sl(row_idx, i * chunk_rows, chunk_rows)
+                if slot_cum is not None:
+                    raw = slot_from_position(pos, slot_cum)
+                else:
+                    raw = table_lookup(jnp.take(leaf_id, idx), slot_of_leaf)
             pk = jnp.take(packed, idx, axis=0)                    # [R, Wb] u8
             xc = unpack_codes(pk[:, :ncb], num_features, code_mode)
             w = unpack_weights(pk[:, ncb:], ch, f32=(hilo == "f32"))  # [R, ch]
-            if slot_cum is not None:
-                raw = slot_from_position(pos, slot_cum)
-            else:
-                raw = table_lookup(jnp.take(leaf_id, idx), slot_of_leaf)
             slot = jnp.where(valid, raw, -1)                       # [R]
         else:
             xc = sl(X, i * chunk_rows, chunk_rows)

@@ -44,7 +44,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .histogram import (NUM_CHANNELS, NUM_CHANNELS_FAST, code_bytes,
                         combine_channels, pack_rows, slot_from_position,
-                        table_lookup, unpack_weights)
+                        slot_position_base, table_lookup, unpack_weights)
 
 _INTERPRET = False   # flipped by tests on CPU
 
@@ -185,6 +185,10 @@ def build_histograms_pallas(
     slot_counts: jnp.ndarray = None,   # [S] i32: row_idx is slot-grouped —
                                        # slots derive from position (no
                                        # leaf_id/slot_of_leaf row gathers)
+    slot_starts: jnp.ndarray = None,   # [S] i32: row_idx is a LEAF-CONTIGUOUS
+                                       # permutation (grower incremental
+                                       # partition) — positions remap through
+                                       # slot_position_base before the gather
     packed: jnp.ndarray = None,        # pre-built pack_rows output (amortize
                                        # the O(N) pack across a tree's waves)
     max_rows: int = 0,                 # STATIC cap on n_active (0 = N). The
@@ -228,11 +232,19 @@ def build_histograms_pallas(
         def gather_chunk(c, bufs):
             pb, sb = bufs
             sl = c * Rg
-            idx = jax.lax.dynamic_slice_in_dim(row_idx, sl, Rg)
             pos = sl + iota_r
             if slot_cum is not None:
                 raw = slot_from_position(pos, slot_cum)
+                if slot_starts is not None:
+                    # leaf-contiguous permutation (incremental partition):
+                    # positions translate into the pending segments
+                    src = pos + slot_position_base(raw, slot_cum, slot_starts)
+                    idx = jnp.take(row_idx,
+                                   jnp.clip(src, 0, row_idx.shape[0] - 1))
+                else:
+                    idx = jax.lax.dynamic_slice_in_dim(row_idx, sl, Rg)
             else:
+                idx = jax.lax.dynamic_slice_in_dim(row_idx, sl, Rg)
                 raw = table_lookup(jnp.take(leaf_id, idx), slot_of_leaf)
             chunk_slot = jnp.where(pos < n_active, raw, -1)
             upd = jax.lax.dynamic_update_slice_in_dim

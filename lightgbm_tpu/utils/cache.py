@@ -146,10 +146,11 @@ def pallas_kernel_source_hash() -> str:
 def pallas_config_key(code_bytes: int, num_bins: int, num_slots: int,
                       num_features: int, num_channels: int = 5) -> str:
     """Stable name for one kernel shape class — what the on-chip gate
-    validates and what the EXPLICIT ``tpu_hist_kernel=pallas|mixed`` knobs
-    consult on a real TPU backend to warn about never-gated shapes
-    (``auto`` always resolves to xla, the round-5 measured end-to-end best
-    — boosting/gbdt.py kernel-resolution block). Mosaic lowering failures
+    validates, what ``tpu_hist_kernel=auto`` consults on a real TPU to
+    decide whether the mixed dispatch is trusted for this shape (validated
+    => mixed, otherwise xla — boosting/gbdt.py kernel-resolution block),
+    and what the EXPLICIT ``pallas|mixed`` knobs consult to warn about
+    never-gated shapes. Mosaic lowering failures
     observed in round 5 were shape-triggered (the S=25 x ch=5 accumulator,
     the cb=2 byte-combine), so trust is granted per shape, not per kernel.
     The weight-channel count is part of the shape (the accumulator is
@@ -165,10 +166,11 @@ def pallas_validated_on_chip(config_key=None) -> bool:
     for ``config_key``'s shape class when the marker carries a per-config
     list (round-5 gates onward; ``pallas_config_key`` builds keys).
 
-    This is the TRUST RECORD behind the explicit ``tpu_hist_kernel=
-    pallas|mixed`` knobs (``auto`` always resolves to xla — the round-5
-    measured end-to-end best): the booster consults it on a real TPU and
-    warns when the resolved shape class was never gated. The kernel is
+    This is the TRUST RECORD behind the ``tpu_hist_kernel`` knob: ``auto``
+    resolves to the mixed dispatch on a real TPU iff this returns True for
+    the booster's shape class (xla otherwise), and the explicit
+    ``pallas|mixed`` knobs consult it to warn when the resolved shape class
+    was never gated. The kernel is
     equality-tested in interpret mode on every CI run, but Mosaic lowering
     on a particular libtpu is only trusted after the hardware gate has
     actually executed there — the same role as the reference's

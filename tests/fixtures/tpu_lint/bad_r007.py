@@ -1,0 +1,21 @@
+"""Fixture: violates exactly R007 — argsort reachable from a while_loop
+body (here via a helper the body calls, the grower's old compact-pass
+shape)."""
+import jax
+import jax.numpy as jnp
+
+
+def grow(leaf_id, state):
+    def regroup(lid):
+        key = jnp.where(lid >= 0, lid, jnp.int32(2 ** 30))
+        return jnp.argsort(key, stable=True)     # R007: per-wave sort
+
+    def cond(s):
+        return s[0] < 4
+
+    def body(s):
+        i, lid = s
+        order = regroup(lid)
+        return i + 1, jnp.take(lid, order)
+
+    return jax.lax.while_loop(cond, body, state)

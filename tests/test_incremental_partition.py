@@ -1,0 +1,196 @@
+"""Incremental leaf partition (grower.py GrowState.perm — the reference's
+DataPartition analog, maintained across waves).
+
+Pins the tentpole contracts of the wave-loop fixed-cost PR:
+
+- the steady-state wave body compiles to a jaxpr with NO sort primitive
+  (the per-wave full-N stable argsort is gone); the legacy path
+  (tpu_incremental_partition=false) still contains one — which both keeps
+  the A/B comparison honest and proves the inspection itself is sensitive;
+- trees grown with the incremental partition are BIT-identical to the
+  legacy per-wave argsort rebuild: serial and tree_learner=data, bagging +
+  feature_fraction RNG, forced compaction (tpu_compact_frac=1.0), u4
+  bit-packed code mode, exact leaf-wise ordering (tpu_wave_size=1),
+  tree_batch>1, checkpoint-resume mid-tree-batch, and the mixed
+  XLA/Pallas kernel dispatch (interpret mode);
+- the config knob round-trips.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.grower import GrowerSpec, grow_tree
+
+
+def _make_binary(n=3000, f=10, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    logit = X[:, 0] - 0.5 * X[:, 1] + X[:, 2] * X[:, 3]
+    y = (logit + rng.randn(n).astype(np.float32) * 0.2 > 0.3).astype(
+        np.float32)
+    return X, y
+
+
+# tpu_compact_frac=1.0 forces the compacted pass on every wave after the
+# root — the incremental remap must carry the whole tree, not just the tail
+BASE = dict(objective="binary", num_leaves=31, learning_rate=0.1,
+            min_data_in_leaf=3, device="cpu", verbose=-1, seed=5,
+            bagging_fraction=0.7, bagging_freq=2, feature_fraction=0.8,
+            tpu_compact_frac=1.0, metric="none")
+
+
+def _train(X, y, incremental, rounds=8, **extra):
+    params = dict(BASE, tpu_incremental_partition=incremental, **extra)
+    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+def _assert_identical(b1, b2, X):
+    np.testing.assert_array_equal(b1.predict(X), b2.predict(X))
+    np.testing.assert_array_equal(b1.predict(X, raw_score=True),
+                                  b2.predict(X, raw_score=True))
+    assert len(b1.trees) == len(b2.trees)
+    for t1, t2 in zip(b1.trees, b2.trees):
+        np.testing.assert_array_equal(t1.leaf_value, t2.leaf_value)
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+
+
+# ---------------------------------------------------------------- jaxpr pin
+
+def _jaxpr_has_sort(jaxpr) -> bool:
+    """Recursively walk a (Closed)Jaxpr for the `sort` primitive — covers
+    sub-jaxprs carried in eqn params (while_loop/cond/scan bodies)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "sort":
+            return True
+        for v in eqn.params.values():
+            for j in (v if isinstance(v, (list, tuple)) else [v]):
+                inner = getattr(j, "jaxpr", None)
+                if inner is not None and _jaxpr_has_sort(inner):
+                    return True
+                if hasattr(j, "eqns") and _jaxpr_has_sort(j):
+                    return True
+    return False
+
+
+@pytest.mark.parametrize("incremental,expect_sort", [(True, False),
+                                                     (False, True)])
+def test_wave_loop_jaxpr_sort_presence(incremental, expect_sort):
+    """The steady-state wave body carries NO sort op on the incremental
+    path; the legacy path still does — proving both the tentpole claim and
+    the sensitivity of this very inspection."""
+    N, F, B, L = 1024, 6, 16, 15
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randint(0, B, size=(N, F)).astype(np.uint8))
+    g = jnp.asarray(rng.randn(N).astype(np.float32))
+    ones = jnp.ones(N, jnp.float32)
+    nb = jnp.full(F, B, jnp.int32)
+    zeros_f = jnp.zeros(F, jnp.int32)
+    spec = GrowerSpec(num_leaves=L, num_features=F, num_bins_padded=B,
+                      chunk_rows=256, hist_slots=4, wave_size=4, max_depth=0,
+                      lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=5.0,
+                      min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0,
+                      row_compact=True, incremental_partition=incremental,
+                      compact_frac=1.0)
+    jx = jax.make_jaxpr(lambda gg: grow_tree(
+        X, gg, ones, ones, jnp.ones(F, bool), jnp.zeros(F, bool), nb,
+        zeros_f, zeros_f, spec))(g)
+    assert _jaxpr_has_sort(jx.jaxpr) == expect_sort
+
+
+# ------------------------------------------------------- bit-identity pins
+
+@pytest.mark.parametrize("tree_learner", ["serial", "data"])
+def test_incremental_vs_legacy_bit_identical(tree_learner):
+    X, y = _make_binary()
+    b_inc = _train(X, y, True, tree_learner=tree_learner)
+    b_leg = _train(X, y, False, tree_learner=tree_learner)
+    _assert_identical(b_inc, b_leg, X)
+
+
+def test_incremental_vs_legacy_u4_code_mode():
+    """max_bin=15 engages the u4 nibble-packed row layout — the compacted
+    gather's unpack must see the identical byte stream through the
+    position remap."""
+    X, y = _make_binary(seed=11)
+    b_inc = _train(X, y, True, max_bin=15)
+    b_leg = _train(X, y, False, max_bin=15)
+    _assert_identical(b_inc, b_leg, X)
+
+
+def test_incremental_vs_legacy_exact_leafwise():
+    """tpu_wave_size=1 (the reference's one-leaf-at-a-time ordering) takes
+    maximally many waves — the partition survives the longest carry chains."""
+    X, y = _make_binary(seed=3)
+    b_inc = _train(X, y, True, tpu_wave_size=1, rounds=4)
+    b_leg = _train(X, y, False, tpu_wave_size=1, rounds=4)
+    _assert_identical(b_inc, b_leg, X)
+
+
+@pytest.mark.parametrize("tree_learner", ["serial", "data"])
+def test_incremental_tree_batch_bit_identical(tree_learner):
+    """tree_batch>1 fuses whole iterations under lax.scan — the per-tree
+    partition reset (identity permutation at tree start) must hold inside
+    the scan carry too. rounds=10 with K=4 exercises the final partial
+    batch."""
+    X, y = _make_binary()
+    b_inc = _train(X, y, True, tree_learner=tree_learner, tree_batch=4,
+                   rounds=10)
+    b_leg = _train(X, y, False, tree_learner=tree_learner, tree_batch=4,
+                   rounds=10)
+    _assert_identical(b_inc, b_leg, X)
+    # and against the unfused incremental run: K>1 stays bit-identical to
+    # K=1 with the new carry
+    b_inc1 = _train(X, y, True, tree_learner=tree_learner, tree_batch=1,
+                    rounds=10)
+    np.testing.assert_array_equal(b_inc.predict(X), b_inc1.predict(X))
+
+
+def test_incremental_checkpoint_resume_mid_tree_batch(tmp_path):
+    """Interrupt a batched incremental run at a batch boundary, resume it,
+    and land bit-identical to BOTH the uninterrupted incremental run and
+    the legacy-partition run."""
+    X, y = _make_binary()
+    ck = str(tmp_path / "ck")
+    params = dict(BASE, tpu_incremental_partition=True, tree_batch=4,
+                  checkpoint_dir=ck, checkpoint_interval=4)
+    full = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=12)
+    lgb.train(dict(params), lgb.Dataset(X, label=y), num_boost_round=8)
+    resumed = lgb.train(dict(params, resume_from="auto"),
+                        lgb.Dataset(X, label=y), num_boost_round=12)
+    np.testing.assert_array_equal(full.predict(X), resumed.predict(X))
+    legacy = lgb.train(dict(BASE, tpu_incremental_partition=False,
+                            tree_batch=4),
+                       lgb.Dataset(X, label=y), num_boost_round=12)
+    np.testing.assert_array_equal(full.predict(X), legacy.predict(X))
+
+
+def test_incremental_mixed_kernel_interpret(monkeypatch):
+    """The mixed dispatch routes COMPACTED passes through the Pallas kernel
+    — its chunk gather must read the carried permutation through the same
+    position remap (interpret mode on the CPU harness)."""
+    from lightgbm_tpu.ops import pallas_histogram as ph
+    monkeypatch.setattr(ph, "_INTERPRET", True)
+    X, y = _make_binary(n=2048, seed=9)
+    b_inc = _train(X, y, True, tpu_hist_kernel="mixed", rounds=4)
+    b_leg = _train(X, y, False, tpu_hist_kernel="mixed", rounds=4)
+    _assert_identical(b_inc, b_leg, X)
+
+
+def test_incremental_off_when_row_compact_off():
+    """row_compact=false never builds the permutation carry (perm stays a
+    None pytree leaf) and still trains; the knob round-trips through
+    Config."""
+    from lightgbm_tpu.config import Config
+    assert Config.from_params({}).tpu_incremental_partition is True
+    assert Config.from_params(
+        dict(tpu_incremental_partition=False)).tpu_incremental_partition \
+        is False
+    X, y = _make_binary(n=800)
+    b = _train(X, y, True, tpu_row_compact=False, rounds=3)
+    b2 = _train(X, y, False, tpu_row_compact=False, rounds=3)
+    _assert_identical(b, b2, X)

@@ -223,13 +223,58 @@ def test_max_rows_capped_buffers_match():
                                   np.asarray(ref[..., 2]))
 
 
+def test_slot_starts_permutation_matches_prefix_layout():
+    """Leaf-contiguous permutation + slot_starts (the grower's incremental
+    partition layout) must produce the same histograms as the legacy
+    slot-grouped prefix, through BOTH kernels."""
+    X, g, h, inc, leaf_id = _data(seed=5)
+    S, B = 4, 32
+    slot_of_leaf = jnp.full(9, -1, jnp.int32).at[jnp.arange(1, 5)].set(
+        jnp.arange(4))
+    # legacy: stable argsort prefix + per-slot counts
+    sr = slot_of_leaf[leaf_id]
+    key = jnp.where(sr >= 0, sr, jnp.int32(2 ** 30))
+    row_idx = jnp.argsort(key, stable=True).astype(jnp.int32)
+    counts = jnp.sum((sr[:, None] == jnp.arange(S)[None, :]).astype(
+        jnp.int32), axis=0)
+    n_active = jnp.sum((sr >= 0).astype(jnp.int32))
+    ref = build_histograms(X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S,
+                           num_bins_padded=B, chunk_rows=1024,
+                           row_idx=row_idx, n_active=n_active,
+                           slot_counts=counts)
+    # incremental layout: rows grouped by leaf id (a valid leaf-contiguous
+    # permutation); pending leaves 1..4 serve slots 0..3
+    perm = jnp.argsort(leaf_id, stable=True).astype(jnp.int32)
+    cnts_leaf = np.bincount(np.asarray(leaf_id), minlength=9)
+    starts_leaf = np.zeros(9, np.int64)
+    starts_leaf[1:] = np.cumsum(cnts_leaf)[:-1]
+    slot_starts = jnp.asarray(starts_leaf[1:5].astype(np.int32))
+    slot_counts = jnp.asarray(cnts_leaf[1:5].astype(np.int32))
+    out_xla = build_histograms(X, g, h, inc, leaf_id, slot_of_leaf,
+                               num_slots=S, num_bins_padded=B,
+                               chunk_rows=1024, row_idx=perm,
+                               n_active=n_active, slot_counts=slot_counts,
+                               slot_starts=slot_starts)
+    np.testing.assert_array_equal(np.asarray(out_xla), np.asarray(ref))
+    out_pl = ph.build_histograms_pallas(
+        X, g, h, inc, leaf_id, slot_of_leaf, num_slots=S, num_bins_padded=B,
+        chunk_rows=1024, row_idx=perm, n_active=n_active,
+        slot_counts=slot_counts, slot_starts=slot_starts,
+        max_rows=X.shape[0])
+    np.testing.assert_allclose(np.asarray(out_pl), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(out_pl[..., 2]),
+                                  np.asarray(ref[..., 2]))
+
+
 def test_auto_kernel_gated_by_onchip_marker(monkeypatch, tmp_path):
     """pallas_validated_on_chip trusts a kernel shape class ONLY when the
     on-chip gate marker lists it, all pins match, AND the backend is a
     real TPU (utils/cache.py) — the runtime analog of the reference
-    gating its GPU learner on GPU_DEBUG_COMPARE passing. (Round 5:
-    tpu_hist_kernel=auto resolves to xla on end-to-end measurement; the
-    marker remains the trust record for the explicit pallas/mixed knobs.)
+    gating its GPU learner on GPU_DEBUG_COMPARE passing. (Round 6:
+    tpu_hist_kernel=auto resolves to the MIXED dispatch on a real TPU iff
+    this trust record validates the booster's shape class, xla otherwise;
+    the explicit pallas/mixed knobs consult it to warn on un-gated shapes.)
     """
     import json
 

@@ -26,6 +26,7 @@ BAD_FIXTURES = [
     ("bad_r004.py", "R004"),
     ("bad_r005.py", "R005"),
     ("bad_r006.py", "R006"),
+    ("bad_r007.py", "R007"),
 ]
 
 
@@ -36,6 +37,33 @@ def test_bad_fixture_violates_exactly_its_rule(relpath, rule):
     assert findings, f"{relpath}: expected {rule} finding(s), got none"
     assert {f.rule for f in findings} == {rule}, \
         f"{relpath}: expected only {rule}, got {[f.format() for f in findings]}"
+
+
+def test_r007_ignores_sorts_outside_while_loops(tmp_path):
+    """Host-side / setup-time sorts are legitimate — R007 only fires on
+    code reachable from a lax.while_loop body."""
+    p = tmp_path / "mod.py"
+    p.write_text("import jax.numpy as jnp\n\n\n"
+                 "def host_rank(x):\n"
+                 "    return jnp.argsort(x, stable=True)\n")
+    findings, err = lint_file(str(p))
+    assert err is None and findings == [], [f.format() for f in findings]
+
+
+def test_r007_grower_legacy_site_is_baseline_exempt():
+    """The grower's LEGACY compact path (tpu_incremental_partition=false,
+    the bit-identity pin) keeps its intentional argsort — R007 sees it,
+    the committed baseline absorbs it, and the incremental default path
+    contributes no findings (the jaxpr-level twin of this pin lives in
+    test_incremental_partition.py)."""
+    findings, err = lint_file(
+        os.path.join(REPO, "lightgbm_tpu", "grower.py"),
+        rel=os.path.join("lightgbm_tpu", "grower.py"))
+    assert err is None
+    r007 = [f for f in findings if f.rule == "R007"]
+    assert len(r007) == 1 and "argsort" in r007[0].snippet
+    bl = Baseline.load(os.path.join(REPO, "tpu_lint_baseline.json"))
+    assert bl.suppresses(r007[0])
 
 
 def test_clean_fixture_has_no_findings():
