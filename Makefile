@@ -42,4 +42,12 @@ capi:
 bench-cpu:
 	LGBM_TPU_BENCH_ROWS=400000 JAX_PLATFORMS=cpu python bench.py
 
-.PHONY: lint verify check-fast check capi bench-cpu chaos
+# Perfetto-loadable trace from the hermetic smoke run (docs/Observability.md):
+# open the printed trace_*.json at https://ui.perfetto.dev. The smoke run
+# also enforces the telemetry overhead contract (zero recompiles / zero new
+# host syncs in the fused step with spans on).
+trace:
+	env LGBM_TPU_TELEMETRY_DIR=$(CURDIR)/.telemetry python bench.py --smoke
+	@echo "trace: $$(ls -1t .telemetry/trace_*.json | head -1)"
+
+.PHONY: lint verify check-fast check capi bench-cpu chaos trace

@@ -136,7 +136,7 @@ def _timed_update_phase(name, bst, warmup, timed, timings, tree_batch=1):
     committed-sharding steady variant) and the timed window is rounded to
     whole batches. Returns (steady_elapsed_s, guard, timed_iters_actual)."""
     from lightgbm_tpu.analysis.guards import RecompileGuard
-    from lightgbm_tpu.utils.timer import PhaseBreakdown
+    from lightgbm_tpu.observability import PhaseBreakdown
     g = bst._gbdt
     tb = max(1, tree_batch)
     if tb > 1:
@@ -372,6 +372,8 @@ def run_bench(deadline, attempt=0, platform=None):
     compile_cache_dir = maybe_enable_compile_cache(repo_cache_dir())
 
     import lightgbm_tpu as lgb
+    from lightgbm_tpu import observability as obs
+    obs.maybe_configure_from_env()       # LGBM_TPU_TELEMETRY_DIR
 
     kernel = os.environ.get("LGBM_TPU_BENCH_KERNEL", "auto")
     if attempt > 0:
@@ -754,6 +756,25 @@ def run_bench(deadline, attempt=0, platform=None):
     except Exception as e:                                   # noqa: BLE001
         result["parity_error"] = str(e)[:200]
 
+    # ---- telemetry summary block (docs/Observability.md) ------------------
+    # counter snapshot + trace file path from the ONE process-wide registry
+    # (PhaseBreakdown/RecompileGuard numbers land there too) — present only
+    # when a telemetry dir is configured; phase_timings stays byte-
+    # compatible with the BENCH_r* trajectory scripts either way.
+    try:
+        if obs.enabled():
+            trace_file = obs.flush()
+            snap = obs.snapshot()
+            result["telemetry"] = {
+                "counters": snap["counters"],
+                "histograms": snap["histograms"],
+                "trace_file": trace_file,
+                "events_file": obs.jsonl_path(),
+            }
+            _PARTIAL["result"] = dict(result)
+    except Exception as e:                                   # noqa: BLE001
+        result["telemetry_error"] = str(e)[:200]
+
     return result
 
 
@@ -995,8 +1016,23 @@ def run_smoke():
     guards hold."""
     from lightgbm_tpu.utils.hermetic import force_cpu_backend
     force_cpu_backend()
+    import shutil
+    import tempfile
+
     import lightgbm_tpu as lgb
+    from lightgbm_tpu import observability as obs
     from lightgbm_tpu.analysis.guards import GuardViolation, RecompileGuard
+
+    # telemetry is ON for the whole smoke run (the acceptance contract:
+    # telemetry must not perturb any guarded loop below): honor an external
+    # LGBM_TPU_TELEMETRY_DIR (`make trace` sets one), else use a temp dir
+    # that is validated and removed at the end
+    tel_dir = os.environ.get(obs.ENV_TELEMETRY_DIR)
+    tel_tmp = None
+    if not tel_dir:
+        tel_tmp = tempfile.mkdtemp(prefix="lgbm_smoke_telemetry_")
+        tel_dir = tel_tmp
+    obs.configure(telemetry_dir=tel_dir)
 
     n_rows = int(os.environ.get("LGBM_TPU_SMOKE_ROWS", "20000"))
     iters = int(os.environ.get("LGBM_TPU_SMOKE_ITERS", "5"))
@@ -1024,8 +1060,6 @@ def run_smoke():
     report = guard.report()
 
     # ---- checkpoint save/resume round trip under the guard -----------------
-    import shutil
-    import tempfile
     ck_dir = tempfile.mkdtemp(prefix="lgbm_smoke_ckpt_")
     resume_ok, resume_err, resume_misses = True, None, -1
     try:
@@ -1105,20 +1139,107 @@ def run_smoke():
     finally:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
+    # ---- telemetry overhead + Perfetto trace contract ----------------------
+    # (docs/Observability.md) Two assertions:
+    # 1. the FUSED step (tree_batch>1) with span recording ON compiles
+    #    nothing after warm-up and pays zero additional host syncs vs the
+    #    identical loop with recording OFF — telemetry is host bookkeeping
+    #    at dispatch boundaries only;
+    # 2. an engine.train run emits a Chrome trace that is valid trace-event
+    #    JSON with the span nesting train -> iteration -> wave (what
+    #    Perfetto renders).
+    tel_ok, tel_err = True, None
+    tel_misses, tel_syncs = -1, -1
+    try:
+        params_t = dict(params, tree_batch=2)
+        ds_t = lgb.Dataset(X, label=y, params=params_t)
+        bst_t = lgb.Booster(params=params_t, train_set=ds_t)
+        g = bst_t._gbdt
+        for _ in range(2):                     # warm-up: compiles allowed
+            g.train_batch(2)
+        np.asarray(g.score).sum()
+
+        def _fused_loop(label):
+            guard_f = RecompileGuard(label=label, fail=False)
+            guard_f.register(g._batch_step_fns.get(2), "train_step")
+            with guard_f:
+                guard_f.mark_warm()
+                for _ in range(iters):
+                    g.train_batch(2)
+                np.asarray(g.score).sum()      # the one intended host sync
+            return guard_f.report()
+
+        obs.configure(enabled=False)           # A: spans off
+        base_rep = _fused_loop("smoke-telemetry-off")
+        obs.configure(enabled=True)            # B: spans on, same executable
+        tel_rep = _fused_loop("smoke-telemetry-on")
+        tel_misses = tel_rep["post_warmup_cache_misses"]
+        tel_syncs = tel_rep["host_syncs"]
+        if tel_misses:
+            raise RuntimeError(
+                f"fused step recompiled with telemetry on: {tel_misses} "
+                f"post-warm-up cache miss(es)")
+        if tel_syncs > base_rep["host_syncs"]:
+            raise RuntimeError(
+                f"telemetry added host syncs inside the fused step: "
+                f"{tel_syncs} vs baseline {base_rep['host_syncs']}")
+
+        # engine-train run -> flushed trace with the full span hierarchy
+        ds_e = lgb.Dataset(X, label=y, params=params_t)
+        lgb.train(dict(params_t), ds_e, num_boost_round=6)
+        trace_file = obs.trace_path()
+        with open(trace_file) as fh:
+            trace = json.load(fh)
+        events = trace["traceEvents"]
+        assert isinstance(events, list) and events, "empty traceEvents"
+
+        def _contains(outer, inner):
+            return (outer["tid"] == inner["tid"]
+                    and outer["ts"] <= inner["ts"]
+                    and inner["ts"] + inner.get("dur", 0)
+                    <= outer["ts"] + outer["dur"] + 1)
+
+        trains = [e for e in events if e.get("name") == "train"]
+        iters_ev = [e for e in events if e.get("name") == "iteration"]
+        waves = [e for e in events if e.get("name") == "wave"]
+        assert trains, "no train span in trace"
+        assert iters_ev, "no iteration spans in trace"
+        assert waves, "no wave spans in trace"
+        nested = [
+            (t, i, w) for w in waves for i in iters_ev for t in trains
+            if _contains(i, w) and _contains(t, i)]
+        assert nested, "spans are not nested train -> iteration -> wave"
+        # JSONL stream carries the counter snapshot next to the events
+        jl = [json.loads(ln) for ln in open(obs.jsonl_path())
+              if ln.strip()]
+        assert any(r.get("type") == "counters"
+                   and r.get("counters", {}).get("trees.trained")
+                   for r in jl), "no counters record in the JSONL stream"
+    except Exception as e:            # noqa: BLE001 — any failure fails CI
+        tel_ok, tel_err = False, f"{type(e).__name__}: {e}"
+    finally:
+        if tel_tmp:
+            shutil.rmtree(tel_tmp, ignore_errors=True)
+
     out = {"metric": "smoke_recompile_guard", "rows": n_rows, "iters": iters,
            "post_warmup_cache_misses": report["post_warmup_cache_misses"],
            "host_syncs": report["host_syncs"],
            "resume_post_warmup_cache_misses": resume_misses,
            "compile_cache_roundtrip_ok": cache_ok,
-           "ok": ok and resume_ok and cache_ok}
+           "telemetry_ok": tel_ok,
+           "telemetry_post_warmup_cache_misses": tel_misses,
+           "telemetry_dir": None if tel_tmp else tel_dir,
+           "ok": ok and resume_ok and cache_ok and tel_ok}
     if err:
         out["error"] = err[:300]
     if resume_err:
         out["resume_error"] = resume_err[:300]
     if cache_err:
         out["compile_cache_error"] = cache_err[:300]
+    if tel_err:
+        out["telemetry_error"] = tel_err[:300]
     print(json.dumps(out))
-    return 0 if (ok and resume_ok and cache_ok) else 1
+    return 0 if (ok and resume_ok and cache_ok and tel_ok) else 1
 
 
 if __name__ == "__main__":

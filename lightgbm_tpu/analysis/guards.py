@@ -178,9 +178,28 @@ class RecompileGuard:
         self._patch_sync_surface()
         return self
 
+    def _publish_report(self) -> None:
+        """Feed the guard's totals into the process-wide metrics registry
+        (lightgbm_tpu/observability) — the single home of recompile /
+        host-sync counters; bench.py and serving snapshots read them there.
+        Best-effort: the guard must keep working if the registry cannot."""
+        try:
+            from ..observability import get_registry
+        except Exception:                                    # noqa: BLE001
+            return
+        reg = get_registry()
+        misses = sum(self.cache_misses_since_warm().values()) \
+            if self._warm_sizes is not None else 0
+        if misses > 0:
+            reg.counter("recompiles.post_warmup").inc(misses)
+        if self._transfers:
+            reg.counter("host_syncs").inc(self._transfers)
+        reg.counter("guard.windows").inc()
+
     def __exit__(self, exc_type, exc, tb) -> bool:
         self._unpatch_sync_surface()
         self._active = False
+        self._publish_report()
         if exc_type is not None:
             return False
         if self.fail and self._warm_sizes is not None:

@@ -29,9 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import observability as obs
 from ..config import Config
 from ..dataset import ConstructedDataset, Metadata, MetadataDuckTyping
-from ..grower import GrowerSpec, TreeArrays, grow_tree
+from ..grower import GrowerSpec, TreeArrays, grow_tree, waves_for_tree
 from ..ops.histogram import table_lookup
 from ..parallel.comm import make_parallel_context
 from ..metrics import Metric, create_metrics
@@ -480,6 +481,11 @@ class GBDT:
         self.models: List[List] = []        # per iteration: list of K device TreeArrays
         self._num_leaves_dev: List = []     # per iteration: [K] device array
         self.iter_ = 0
+        # telemetry high-water mark: iterations already counted into the
+        # monotonic trees.trained/rows.routed counters (publish_telemetry).
+        # Checkpoint restore and repeated train() calls on one booster bump
+        # it so restored/already-published iterations are never re-counted.
+        self._telemetry_iters_base = 0
         # monotonic forest-content counter: iter_ alone can collide after a
         # rollback (explicit or the no-splits pop) followed by a retrain,
         # which would let stale materialized host trees pass a length check
@@ -543,6 +549,18 @@ class GBDT:
             tb = 1
         self.tree_batch = tb
         self._batch_step_fns: Dict[int, object] = {}
+
+        # telemetry: the resolved kernel choice and dispatch shape of this
+        # booster (observability registry + an instant trace event) — the
+        # per-booster facts the next perf session reads first
+        reg = obs.get_registry()
+        reg.counter(f"booster.kernel.{hist_kernel}").inc()
+        reg.gauge("booster.tree_batch").set(tb)
+        reg.gauge("booster.wave_size").set(self.spec.wave_size)
+        reg.gauge("booster.hist_slots").set(self.spec.hist_slots)
+        obs.event("booster_init", kernel=hist_kernel, tree_batch=tb,
+                  rows=int(N), features=int(F), num_leaves=int(num_leaves),
+                  strategy=self.pctx.strategy, nan_policy=self.nan_policy)
 
     # ------------------------------------------------------------------ setup
 
@@ -886,6 +904,18 @@ class GBDT:
                 raise
         return score, out_valid
 
+    def _record_nan_event(self, what: str, iteration: int) -> None:
+        """Telemetry leg of the nan_policy guard: per-policy counters plus
+        an instant trace event per poisoned iteration — the chaos suite
+        asserts these land in the JSONL stream (tests/test_chaos.py)."""
+        reg = obs.get_registry()
+        reg.counter("nan.events").inc()
+        reg.counter({"clip": "nan.clipped", "raise": "nan.raised",
+                     "skip_iter": "nan.skipped_iters"}.get(
+                         self.nan_policy, "nan.other")).inc()
+        obs.event("nan_policy", policy=self.nan_policy, what=what,
+                  iteration=int(iteration))
+
     @allowed_host_sync("nan_policy guard: one 3-bool flag fetch per "
                        "iteration, only while the guard is enabled")
     def _apply_nan_policy(self, nf) -> bool:
@@ -900,6 +930,7 @@ class GBDT:
             return False
         from ..robustness.numeric import FLAG_NAMES, NonFiniteError
         what = ", ".join(n for n, f in zip(FLAG_NAMES, flags) if f)
+        self._record_nan_event(what, self.iter_ - 1)
         if self.nan_policy == "clip":
             Log.warning("nan_policy=clip: non-finite %s at iteration %d "
                         "were sanitized (NaN->0, Inf->+/-cap)", what,
@@ -924,7 +955,11 @@ class GBDT:
         return True
 
     def train_one_iter(self) -> None:
-        with TIMERS("train_step"):
+        # span nesting mirrors the fused path: one dispatch ("tree_batch",
+        # k=1) holding one iteration — host-side bookkeeping only, no device
+        # value is read (the recompile-free steady state is preserved)
+        with TIMERS("train_step"), obs.span("tree_batch", k=1), \
+                obs.span("iteration", iteration=self.iter_):
             score, out_valid = self._run_step(self.score,
                                               self._step_shrinkage())
             self.score = score
@@ -947,8 +982,14 @@ class GBDT:
         callbacks happen at the caller's batch boundaries (engine.py)."""
         if n <= 1:
             return self.train_one_iter()
-        with TIMERS("train_step"):
+        base_iter = self.iter_
+        with TIMERS("train_step"), obs.span("tree_batch", k=n):
             self._run_fused_batch(n)
+        # the fused scan is ONE dispatch — per-iteration spans inside it are
+        # derived (even slices of the batch span, labeled as such); recorded
+        # after the span closes, host-side only
+        obs.get_tracer().subdivide_last("tree_batch", "iteration", n,
+                                        base_iteration=base_iter)
 
     def _run_fused_batch(self, n: int) -> None:
         fn = self._batch_step_fns.get(n)
@@ -1003,6 +1044,8 @@ class GBDT:
         def _what(i):
             return ", ".join(nm for nm, f in zip(FLAG_NAMES, flags[i]) if f)
 
+        for i in np.nonzero(flags.any(axis=1))[0]:
+            self._record_nan_event(_what(int(i)), base_iter + int(i))
         if self.nan_policy == "clip":
             for i in np.nonzero(flags.any(axis=1))[0]:
                 Log.warning("nan_policy=clip: non-finite %s at iteration %d "
@@ -1064,18 +1107,20 @@ class GBDT:
             Log.fatal("custom objectives are not supported with "
                       "is_pre_partition (host gradients need the full score "
                       "vector on every process)")
-        preds = self._fetch(self.score)[:, :N].reshape(-1)
-        grad, hess = fobj(preds, self.train_set)
-        g = np.zeros((K, Npad), np.float32)
-        h = np.zeros((K, Npad), np.float32)
-        g[:, :N] = np.asarray(grad, np.float32).reshape(K, N)
-        h[:, :N] = np.asarray(hess, np.float32).reshape(K, N)
-        score, out_valid = self._run_step(
-            self.score, self.config.learning_rate,
-            custom_gh=(self._put(g, "rows1"), self._put(h, "rows1")))
-        self.score = score
-        for vi, vs in enumerate(self.valid_sets):
-            vs.score = jnp.stack(out_valid[vi])
+        with obs.span("tree_batch", k=1, custom_fobj=True), \
+                obs.span("iteration", iteration=self.iter_):
+            preds = self._fetch(self.score)[:, :N].reshape(-1)
+            grad, hess = fobj(preds, self.train_set)
+            g = np.zeros((K, Npad), np.float32)
+            h = np.zeros((K, Npad), np.float32)
+            g[:, :N] = np.asarray(grad, np.float32).reshape(K, N)
+            h[:, :N] = np.asarray(hess, np.float32).reshape(K, N)
+            score, out_valid = self._run_step(
+                self.score, self.config.learning_rate,
+                custom_gh=(self._put(g, "rows1"), self._put(h, "rows1")))
+            self.score = score
+            for vi, vs in enumerate(self.valid_sets):
+                vs.score = jnp.stack(out_valid[vi])
 
     def add_base_score(self, raw_scores: np.ndarray,
                        valid_raw: Optional[List[np.ndarray]] = None) -> None:
@@ -1217,7 +1262,7 @@ class GBDT:
                  ) -> List[Tuple[str, str, float, bool]]:
         """only=<dataset name>: evaluate just that dataset (single-dataset
         entry points must not pay for every attached valid set)."""
-        with TIMERS("metric_eval"):
+        with TIMERS("metric_eval"), obs.span("eval", only=only):
             return self._eval_all(force_training, only)
 
     def _eval_all(self, force_training=False, only=None
@@ -1350,6 +1395,9 @@ class GBDT:
                        for it_trees in state["models"]]
         self._num_leaves_dev = [self._put(nl) for nl in state["num_leaves"]]
         self.iter_ = int(state["iter"])
+        # restored iterations were trained (and counted) by the run that
+        # wrote the snapshot — telemetry must only count what THIS run adds
+        self._telemetry_iters_base = len(self.models)
         self.mutations_ = int(state["mutations"])
         self._consecutive_skips = int(state.get("consecutive_skips", 0))
         self.init_score_value = float(state["init_score_value"])
@@ -1365,6 +1413,48 @@ class GBDT:
                 Log.warning("checkpoint has no saved scores for valid set "
                             "%r — its eval scores restart from the initial "
                             "model", vs.name)
+
+    # -------------------------------------------------------------- telemetry
+
+    @allowed_host_sync("telemetry flush: one per-training-run leaf-count "
+                       "fetch at an iteration boundary, only while span "
+                       "recording is enabled")
+    def publish_telemetry(self) -> None:
+        """Flush this booster's per-run training facts into the telemetry
+        subsystem (engine.train calls it once, after the loop): trained-tree
+        and routed-row counters always; with span recording enabled, one
+        batched leaf-count fetch derives the per-tree wave counts
+        (grower.waves_for_tree — a host-side model of the wave loop, no
+        per-wave device traffic) that become the ``wave`` child spans of
+        each recorded ``iteration`` span and the ``tree.waves``/
+        ``tree.leaves`` histograms."""
+        reg = obs.get_registry()
+        base = min(self._telemetry_iters_base, len(self.models))
+        n_new = len(self.models) - base
+        self._telemetry_iters_base = len(self.models)
+        if n_new:
+            # only the iterations THIS run trained: restored-checkpoint and
+            # already-published iterations sit below the high-water mark
+            reg.counter("trees.trained").inc(n_new * self.num_models)
+            reg.counter("rows.routed").inc(
+                n_new * self.num_models * self.num_data)
+        if not obs.enabled() or not n_new:
+            return
+        leaves = jax.device_get(self._num_leaves_dev[base:])  # [n_new][K]
+        wave_hist = reg.histogram("tree.waves")
+        leaf_hist = reg.histogram("tree.leaves")
+        counts = []
+        for nl in leaves:
+            nl = np.atleast_1d(np.asarray(nl))
+            # K trees grow concurrently inside one iteration's dispatch;
+            # the iteration's wave count is the deepest tree's
+            counts.append(max(waves_for_tree(int(v), self.spec.wave_size,
+                                             self.spec.hist_slots)
+                              for v in nl))
+            wave_hist.observe(counts[-1])
+            for v in nl:
+                leaf_hist.observe(int(v))
+        obs.get_tracer().derive_children("iteration", "wave", counts)
 
     # ------------------------------------------------------------------ model
 

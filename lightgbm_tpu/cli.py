@@ -36,6 +36,17 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
         tok = tok.strip()
         if not tok or tok.startswith("#"):
             continue
+        if tok.startswith("--"):
+            # GNU-style convenience form: `--telemetry-dir=/x` ==
+            # `telemetry_dir=/x` (the reference CLI is strictly key=value).
+            # Only the KEY normalizes dashes to underscores — the value must
+            # pass through untouched (`--data=/path/my-file.csv`)
+            tok = tok[2:]
+            if "=" in tok:
+                k, v = tok.split("=", 1)
+                tok = k.replace("-", "_") + "=" + v
+            else:
+                tok = tok.replace("-", "_")
         if "=" not in tok:
             # convenience subcommand form: `cli train config=...` ==
             # `cli task=train config=...` (the reference CLI is strictly
@@ -86,6 +97,14 @@ def _load_dataset(path: str, params: Dict, config: Config,
 
 def run_train(params: Dict) -> None:
     config = Config.from_params(params)
+    # reference verbosity semantics (utils/log.py): <0 fatal-only,
+    # 0 warnings, 1 info, >1 debug
+    Log.set_level(config.verbose)
+    if config.telemetry_dir:
+        # telemetry_dir=... / --telemetry-dir=...: JSONL + Perfetto trace
+        # under this directory (docs/Observability.md); engine.train flushes
+        from . import observability as obs
+        obs.configure(telemetry_dir=config.telemetry_dir)
     if not config.data:
         Log.fatal("No training data specified (data=...)")
     train_set = _load_dataset(config.data, params, config)
@@ -160,6 +179,7 @@ def run_train(params: Dict) -> None:
 
 def run_predict(params: Dict) -> None:
     config = Config.from_params(params)
+    Log.set_level(config.verbose)
     if not config.input_model:
         Log.fatal("No input model specified for prediction (input_model=...)")
     if not config.data:
@@ -181,6 +201,7 @@ def run_predict(params: Dict) -> None:
 
 def run_convert_model(params: Dict) -> None:
     config = Config.from_params(params)
+    Log.set_level(config.verbose)
     if not config.input_model:
         Log.fatal("No input model specified (input_model=...)")
     booster = Booster(params=params, model_file=config.input_model)

@@ -319,6 +319,19 @@ class Config:
     # training; tpu_profile_dir wraps training in a jax.profiler trace
     tpu_time_tag: bool = False
     tpu_profile_dir: str = ""
+    # jax.profiler capture WINDOW "start:stop" over boosting iterations
+    # (batch-boundary aligned under tree_batch) — the deep-profiling leg of
+    # the telemetry contract; output under tpu_profile_dir, or
+    # <telemetry_dir>/xprof when only telemetry_dir is set. See
+    # docs/Observability.md.
+    tpu_profile_iters: str = ""
+
+    # --- observability (lightgbm_tpu/observability, docs/Observability.md) --
+    # telemetry output directory: JSONL event stream (events_<pid>.jsonl) +
+    # Perfetto-loadable Chrome trace (trace_<pid>.json). Also settable via
+    # env LGBM_TPU_TELEMETRY_DIR; empty + no env = span recording disabled
+    # (the metrics registry is always live)
+    telemetry_dir: str = ""
     # boosting iterations fused into ONE jit dispatch via lax.scan (built-in
     # objectives only): score updates, tree growth, and leaf application for
     # K trees never leave HBM, and the host loop pays dispatch + sync cost
@@ -417,6 +430,12 @@ class Config:
         if self.checkpoint_interval > 0 and not self.checkpoint_dir:
             Log.fatal("checkpoint_interval=%d needs checkpoint_dir to be set",
                       self.checkpoint_interval)
+        if self.tpu_profile_iters:
+            from .observability.profiler import parse_profile_iters
+            try:
+                parse_profile_iters(self.tpu_profile_iters)
+            except ValueError as e:
+                Log.fatal("%s", e)
         if self.boosting_normalized == "dart" and (self.checkpoint_dir
                                                    or self.resume_from):
             # reject at config time, not at the first save: otherwise the

@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import collections
+import os
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -43,6 +44,24 @@ def train(params: Dict[str, Any], train_set: Dataset,
     maybe_enable_compile_cache()
 
     params = dict(params or {})
+    # verbosity -> Log.set_level BEFORE construction so construction-time
+    # messages (EFB, kernel resolution, unknown-parameter warnings) already
+    # honor it; the resolved config value is re-applied below. Only the
+    # canonical name and its alias are peeked — full alias resolution
+    # happens (with its own warnings) inside Config.from_params.
+    _v = params.get("verbose", params.get("verbosity"))
+    if _v is not None:
+        try:
+            Log.set_level(int(_v))
+        except (TypeError, ValueError):
+            pass
+    # telemetry config BEFORE booster construction: the booster_init event
+    # and construction-time counters must land in the recording
+    # (lightgbm_tpu/observability, docs/Observability.md)
+    from . import observability as obs
+    obs.maybe_configure_from_env()
+    if params.get("telemetry_dir"):
+        obs.configure(telemetry_dir=str(params["telemetry_dir"]))
     if "num_iterations" not in params and "num_boost_round" not in params:
         params["num_iterations"] = num_boost_round
     if early_stopping_rounds is not None:
@@ -59,6 +78,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     booster = Booster(params=params, train_set=train_set)
     config = booster.config
+    # the reference's verbosity semantics (utils/log.py Log.set_level):
+    # <0 fatal-only, 0 warnings, 1 info, >1 debug — wired from the resolved
+    # config on every train entry (cli.py and sklearn.py wire their own)
+    Log.set_level(config.verbose)
     n_rounds = config.num_iterations
 
     valid_sets = valid_sets or []
@@ -199,11 +222,30 @@ def train(params: Dict[str, Any], train_set: Dataset,
     from .utils.timer import TIMERS, maybe_xla_trace
     if config.tpu_time_tag:
         TIMERS.enabled = True
+    # ---- telemetry (lightgbm_tpu/observability, docs/Observability.md) -----
+    # span recording turned on above when a telemetry dir is configured
+    # (param or LGBM_TPU_TELEMETRY_DIR); the metrics registry is always
+    # live. The optional jax.profiler window (tpu_profile_iters) captures a
+    # bounded iteration range at batch boundaries; it supersedes the
+    # whole-run tpu_profile_dir trace (double-tracing is a jax error).
+    from .observability.profiler import ProfileWindow
+    if config.telemetry_dir:
+        obs.configure(telemetry_dir=config.telemetry_dir)
+    _profile_out = config.tpu_profile_dir or (
+        os.path.join(obs.telemetry_dir(), "xprof")
+        if obs.telemetry_dir() else "")
+    profile_window = ProfileWindow(config.tpu_profile_iters, _profile_out)
+    whole_run_profile = "" if profile_window.enabled \
+        else config.tpu_profile_dir
     try:
-        with maybe_xla_trace(config.tpu_profile_dir):
+        with maybe_xla_trace(whole_run_profile), \
+                obs.span("train", rows=gbdt.num_data, n_rounds=n_rounds,
+                         start_iter=start_iter, tree_batch=tree_batch,
+                         objective=config.objective):
             it = start_iter
             while it < n_rounds:
                 k = min(tree_batch, n_rounds - it)
+                profile_window.before_step(it, k)
                 for cb in callbacks_before:
                     cb(CallbackEnv(booster, params, it, 0, n_rounds, None))
                 if fobj is not None:
@@ -211,6 +253,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 else:
                     gbdt.train_batch(k)
                 it_end = it + k
+                profile_window.after_step(it_end)
                 eval_results = []
                 if gbdt.valid_sets or gbdt.config.is_training_metric:
                     # eval when the batch crossed a metric_freq boundary
@@ -228,6 +271,21 @@ def train(params: Dict[str, Any], train_set: Dataset,
     except EarlyStopException as e:
         best_iteration = e.best_iteration + 1
         booster.best_score = e.best_score
+    finally:
+        profile_window.close()
+        # telemetry finalize + flush must never take the run down — and must
+        # run on EVERY exit path (early stop, nan_policy=raise, comm errors)
+        # so the trace on disk reflects what actually happened
+        try:
+            gbdt.publish_telemetry()
+        except Exception as e:                               # noqa: BLE001
+            Log.warning("telemetry publish failed: %s: %s",
+                        type(e).__name__, e)
+        try:
+            obs.flush()
+        except Exception as e:                               # noqa: BLE001
+            Log.warning("telemetry flush failed: %s: %s",
+                        type(e).__name__, e)
 
     booster._finalize()
     TIMERS.dump()       # reference TIMETAG destructor dump (gbdt.cpp)

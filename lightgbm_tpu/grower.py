@@ -210,6 +210,20 @@ class GrowerSpec:
                     min_data_per_group=self.min_data_per_group)
 
 
+def waves_for_tree(num_leaves: int, wave_size: int, hist_slots: int) -> int:
+    """Host-side wave-count model of the while_loop below, for telemetry
+    attribution (GBDT.publish_telemetry): a tree that finished with
+    ``num_leaves`` leaves applied ``num_leaves - 1`` splits in batches of
+    ``min(wave_size, hist_slots)`` — the cap step 5's top_k enforces. The
+    count is derived from the finished tree alone (no per-wave device
+    traffic); it undercounts by the terminal no-split wave when growth
+    stopped on gain rather than the leaf budget, which the derived "wave"
+    spans document via their ``derived`` tag."""
+    cap = max(1, min(wave_size, hist_slots) if wave_size > 0 else hist_slots)
+    splits = max(0, int(num_leaves) - 1)
+    return max(1, -(-splits // cap))
+
+
 def _empty_tree(L: int, B: int) -> TreeArrays:
     M = L - 1
     return TreeArrays(

@@ -1,0 +1,154 @@
+"""Unified training telemetry (docs/Observability.md).
+
+One subsystem for every runtime signal the boosting stack produces:
+
+- ``SpanTracer`` (tracer.py)      — nested host-side spans
+  (train -> tree_batch -> iteration -> wave, plus eval/comm/checkpoint),
+  recorded at dispatch boundaries only so the fused step and the
+  recompile-free steady state are preserved.
+- ``MetricsRegistry`` (metrics.py) — process-wide counters/gauges/
+  histograms absorbing ``RecompileGuard.report()``, ``PhaseBreakdown``,
+  comm retries/timeouts, ``nan_policy`` events, checkpoint writes,
+  per-booster kernel choice, waves per tree, rows routed.
+- exporters (export.py)           — JSONL event stream + Chrome trace-event
+  JSON (Perfetto-loadable) under ``LGBM_TPU_TELEMETRY_DIR`` / config
+  ``telemetry_dir``; ``snapshot()`` is the point-in-time serving API.
+- ``ProfileWindow`` (profiler.py) — optional ``jax.profiler`` capture of an
+  iteration range (``tpu_profile_iters=start:stop``).
+
+The module singletons are process-wide on purpose: a training run, the
+bench harness, and a serving probe all read the same registry. Everything
+here is jax-free at import time (the lint CLI and guards publish through
+it in jax-free environments).
+
+Overhead contract: with no telemetry directory configured the tracer is
+disabled — ``span()`` returns a shared no-op and the registry costs one
+dict lookup + int add per event, at host boundaries only. ``bench.py
+--smoke`` enforces that telemetry-on adds zero steady-state recompiles and
+zero new host syncs inside the fused step.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from .metrics import MetricsRegistry
+from .phases import PhaseBreakdown  # noqa: F401  (public: bench phase timing)
+from .tracer import SpanTracer
+
+ENV_TELEMETRY_DIR = "LGBM_TPU_TELEMETRY_DIR"
+
+_registry = MetricsRegistry()
+_tracer = SpanTracer()
+_state: Dict = {"dir": None, "jsonl_cursor": 0, "env_checked": False}
+
+
+# ------------------------------------------------------------- configuration
+
+def get_registry() -> MetricsRegistry:
+    return _registry
+
+
+def get_tracer() -> SpanTracer:
+    return _tracer
+
+
+def enabled() -> bool:
+    """True when spans are being recorded (a telemetry dir is configured or
+    the tracer was force-enabled)."""
+    return _tracer.enabled
+
+
+def telemetry_dir() -> Optional[str]:
+    return _state["dir"]
+
+
+def configure(telemetry_dir: Optional[str] = None,
+              enabled: Optional[bool] = None) -> None:
+    """Point the exporters at ``telemetry_dir`` (created if missing) and/or
+    force the tracer on/off. Setting a directory enables the tracer unless
+    ``enabled=False`` is passed explicitly."""
+    if telemetry_dir:
+        os.makedirs(telemetry_dir, exist_ok=True)
+        _state["dir"] = telemetry_dir
+        if enabled is None:
+            enabled = True
+    if enabled is not None:
+        _tracer.enabled = bool(enabled)
+
+
+def maybe_configure_from_env() -> None:
+    """Honor ``LGBM_TPU_TELEMETRY_DIR`` once per process (called from every
+    training entry point; explicit ``configure()`` calls always win)."""
+    if _state["env_checked"]:
+        return
+    _state["env_checked"] = True
+    env = os.environ.get(ENV_TELEMETRY_DIR)
+    if env and _state["dir"] is None:
+        configure(telemetry_dir=env)
+
+
+# ----------------------------------------------------------------- recording
+
+def span(name: str, **args):
+    """``with observability.span("tree_batch", k=4): ...`` — no-op when
+    telemetry is disabled."""
+    return _tracer.span(name, **args)
+
+
+def event(name: str, **args) -> None:
+    _tracer.event(name, **args)
+
+
+def inc(name: str, n: int = 1) -> None:
+    _registry.inc(name, n)
+
+
+# ------------------------------------------------------------------- export
+
+def trace_path() -> Optional[str]:
+    d = _state["dir"]
+    return os.path.join(d, f"trace_{os.getpid()}.json") if d else None
+
+
+def jsonl_path() -> Optional[str]:
+    d = _state["dir"]
+    return os.path.join(d, f"events_{os.getpid()}.jsonl") if d else None
+
+
+def snapshot() -> Dict:
+    """Point-in-time metrics snapshot (the serving API): registry contents
+    plus tracer bookkeeping."""
+    snap = _registry.snapshot()
+    snap["spans_recorded"] = len(_tracer.events())
+    snap["spans_dropped"] = _tracer.dropped
+    return snap
+
+
+def flush() -> Optional[str]:
+    """Write pending telemetry to disk: append new events + a counters
+    record to the JSONL stream, rewrite the Chrome trace. Returns the trace
+    path (None when no directory is configured). Called at training exit
+    (engine.train) and bench boundaries — never inside the hot loop."""
+    d = _state["dir"]
+    if not d:
+        return None
+    from .export import JsonlWriter, write_chrome_trace
+    new, _state["jsonl_cursor"] = _tracer.events_since(_state["jsonl_cursor"])
+    records = [dict(ev, type="span" if ev.get("ph") == "X" else "event")
+               for ev in new]
+    records.append(dict(snapshot(), type="counters"))
+    JsonlWriter(jsonl_path()).append(records)
+    return write_chrome_trace(
+        _tracer.events(), trace_path(),
+        metadata={"epoch_unix": _tracer.epoch_unix()})
+
+
+def reset_for_tests() -> None:
+    """Full reset of the process-wide singletons (test isolation)."""
+    _registry.reset()
+    _tracer.reset()
+    _tracer.enabled = False
+    _state["dir"] = None
+    _state["jsonl_cursor"] = 0
+    _state["env_checked"] = False

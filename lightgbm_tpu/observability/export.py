@@ -1,0 +1,71 @@
+"""Telemetry exporters: JSONL event stream + Chrome trace-event JSON.
+
+Two on-disk products per process under the telemetry directory
+(``LGBM_TPU_TELEMETRY_DIR`` / config ``telemetry_dir``):
+
+- ``events_<pid>.jsonl`` — append-only stream of span/instant events plus
+  periodic metric-registry snapshots (``{"type": "counters", ...}``), one
+  JSON object per line. Meant for log shippers and the chaos-test
+  assertions (tests/test_chaos.py).
+- ``trace_<pid>.json``   — Chrome trace-event JSON (``{"traceEvents":
+  [...]}``) loadable directly in Perfetto (https://ui.perfetto.dev) or
+  ``chrome://tracing``. Rewritten atomically on every flush so a reader
+  never sees a torn file.
+
+Writes happen only at flush sites (end of ``engine.train``, bench
+boundaries) — never inside the training loop.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+
+def write_chrome_trace(events: List[Dict], path: str,
+                       metadata: Dict = None) -> str:
+    """Write ``events`` (already in trace-event schema, tracer.py) as a
+    Perfetto-loadable JSON object. tmp+rename so a crash mid-write can
+    never leave a truncated 'valid' trace behind."""
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": dict(metadata or {}, producer="lightgbm_tpu"),
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+    return path
+
+
+class JsonlWriter:
+    """Append-only JSONL sink; one record per line, flushed per batch."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def append(self, records: List[Dict]) -> None:
+        if not records:
+            return
+        with open(self.path, "a") as fh:
+            for rec in records:
+                fh.write(json.dumps(rec) + "\n")
+
+
+def read_jsonl(path: str) -> List[Dict]:
+    """Parse a JSONL stream, skipping torn trailing lines (a reader racing
+    the writer must not crash on the in-flight record)."""
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out

@@ -1,0 +1,139 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+One registry per process (``observability.get_registry()``) absorbs every
+runtime signal the training stack used to scatter across ad-hoc consumers:
+``RecompileGuard.report()`` recompile/host-sync counts (analysis/guards.py
+publishes them on guard exit), comm retry/timeout events
+(robustness/retry.py, parallel/comm.py), ``nan_policy`` events
+(boosting/gbdt.py), checkpoint writes (robustness/checkpoint.py), per-booster
+kernel choice, waves per tree, and rows routed. ``bench.py`` reads the same
+registry for its ``telemetry`` summary block instead of keeping parallel
+bookkeeping.
+
+Deliberately jax-free and dependency-free: the lint CLI
+(``lightgbm_tpu.analysis``) must stay importable in jax-free environments,
+and guards.py publishes here. All mutation happens under one lock — counters
+are incremented at host-side dispatch/retry/flush boundaries (a handful of
+times per iteration at most), never per row, so the lock is nowhere near any
+hot path.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class Counter:
+    """Monotonic event count (e.g. ``comm.retries``)."""
+    __slots__ = ("name", "_lock", "value")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += int(n)
+
+
+class Gauge:
+    """Last-written value (e.g. ``booster.tree_batch``)."""
+    __slots__ = ("name", "_lock", "value")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self.value: Optional[float] = None
+
+    def set(self, v) -> None:
+        with self._lock:
+            self.value = float(v)
+
+
+class Histogram:
+    """Streaming summary (count/sum/min/max) of an observed distribution
+    (e.g. ``tree.waves``). No buckets: the consumers here want the shape of
+    a per-run distribution in a snapshot, not a full HDR histogram."""
+    __slots__ = ("name", "_lock", "count", "sum", "min", "max")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+
+class MetricsRegistry:
+    """Named metric store; metrics are created on first use so producers
+    never need registration order coordination."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    # ------------------------------------------------------------- accessors
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name, self._lock))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name, self._lock))
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name,
+                                                Histogram(name, self._lock))
+        return h
+
+    def inc(self, name: str, n: int = 1) -> None:
+        """Convenience: ``registry.inc("comm.retries")``."""
+        self.counter(name).inc(n)
+
+    # -------------------------------------------------------------- snapshot
+
+    def snapshot(self) -> Dict:
+        """Point-in-time view of every metric — the serving-side API
+        (docs/Observability.md): cheap, lock-consistent, JSON-serializable."""
+        with self._lock:
+            counters = {k: c.value for k, c in sorted(self._counters.items())}
+            gauges = {k: g.value for k, g in sorted(self._gauges.items())}
+            hists = {}
+            for k, h in sorted(self._histograms.items()):
+                hists[k] = {
+                    "count": h.count, "sum": round(h.sum, 6),
+                    "min": h.min, "max": h.max,
+                    "mean": round(h.sum / h.count, 6) if h.count else None,
+                }
+        return {"time_unix": round(time.time(), 3), "counters": counters,
+                "gauges": gauges, "histograms": hists}
+
+    def reset(self) -> None:
+        """Drop every metric (tests; a fresh serving epoch)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()

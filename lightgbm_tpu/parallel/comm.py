@@ -580,60 +580,72 @@ def host_allgather(obj, tag: str, timeout_ms: int = 600_000, *,
     world = jax.process_count() if world is None else world
     if world <= 1:
         return [obj]
+    from .. import observability as _obs
     seq = _host_allgather_seq[0]
     _host_allgather_seq[0] += 1
     key = f"lgbm_hostgather/{tag}/{seq}"
     payload = pickle.dumps(obj)
-    # allow_overwrite makes the retried set idempotent: a first attempt that
-    # landed server-side but lost its ack re-writes the identical payload
-    # instead of failing every retry with ALREADY_EXISTS
-    retry_call(lambda: client.key_value_set_bytes(f"{key}/{rank}", payload,
-                                                  allow_overwrite=True),
-               what=f"host_allgather set tag={tag!r} seq={seq} rank={rank}")
-    out = []
-    # the timeout is a TOTAL budget per peer, split across retry attempts —
-    # a dead peer costs ~timeout_ms, not attempts x timeout_ms (retrying
-    # only pays off for the transient-error/corrupt-payload cases anyway)
-    per_attempt_ms = max(1, timeout_ms // comm_attempts())
-    for r in range(world):
-        if r == rank:
-            out.append(obj)
-            continue
+    _obs.inc("comm.host_allgather")
+    # the whole exchange is one host-side "comm" span (set + per-peer gets
+    # + cleanup barrier): a pure host boundary, no device arrays touched
+    with _obs.span("comm", op="host_allgather", tag=tag, seq=seq,
+                   rank=rank, world=world):
+        # allow_overwrite makes the retried set idempotent: a first attempt
+        # that landed server-side but lost its ack re-writes the identical
+        # payload instead of failing every retry with ALREADY_EXISTS
+        retry_call(lambda: client.key_value_set_bytes(f"{key}/{rank}",
+                                                      payload,
+                                                      allow_overwrite=True),
+                   what=f"host_allgather set tag={tag!r} seq={seq} "
+                        f"rank={rank}")
+        out = []
+        # the timeout is a TOTAL budget per peer, split across retry
+        # attempts — a dead peer costs ~timeout_ms, not
+        # attempts x timeout_ms (retrying only pays off for the
+        # transient-error/corrupt-payload cases anyway)
+        per_attempt_ms = max(1, timeout_ms // comm_attempts())
+        for r in range(world):
+            if r == rank:
+                out.append(obj)
+                continue
 
-        def _get(r=r):
-            # get + unpickle as ONE retried unit: a transiently corrupted
-            # payload (bit rot in flight) re-fetches cleanly
-            raw = client.blocking_key_value_get_bytes(f"{key}/{r}",
-                                                      per_attempt_ms)
-            return pickle.loads(raw)
+            def _get(r=r):
+                # get + unpickle as ONE retried unit: a transiently
+                # corrupted payload (bit rot in flight) re-fetches cleanly
+                raw = client.blocking_key_value_get_bytes(f"{key}/{r}",
+                                                          per_attempt_ms)
+                return pickle.loads(raw)
 
+            try:
+                out.append(retry_call(
+                    _get, what=f"host_allgather get tag={tag!r} seq={seq} "
+                               f"rank={rank}<-{r}"))
+            except Exception as e:
+                _obs.inc("comm.timeouts")
+                raise CommTimeoutError(
+                    f"host_allgather tag={tag!r} seq={seq}: rank {rank} "
+                    f"could not fetch rank {r}'s shard within "
+                    f"~{timeout_ms} ms total over "
+                    f"{e.__class__.__name__}: {e}") from e
+        # every rank must have READ every shard before any key disappears
+        barrier_ok = False
         try:
-            out.append(retry_call(
-                _get, what=f"host_allgather get tag={tag!r} seq={seq} "
-                           f"rank={rank}<-{r}"))
-        except Exception as e:
-            raise CommTimeoutError(
-                f"host_allgather tag={tag!r} seq={seq}: rank {rank} could "
-                f"not fetch rank {r}'s shard within ~{timeout_ms} ms total "
-                f"over {e.__class__.__name__}: {e}") from e
-    # every rank must have READ every shard before any key disappears
-    barrier_ok = False
-    try:
-        client.wait_at_barrier(f"{key}/done", timeout_ms)
-        barrier_ok = True
-    except Exception as e:                                   # noqa: BLE001
-        Log.warning("host_allgather tag=%r seq=%d rank=%d: cleanup barrier "
-                    "failed (%s: %s); leaving key %s/%d for the coordination "
-                    "service to expire", tag, seq, rank,
-                    type(e).__name__, e, key, rank)
-    if barrier_ok:
-        try:
-            client.key_value_delete(f"{key}/{rank}")
+            client.wait_at_barrier(f"{key}/done", timeout_ms)
+            barrier_ok = True
         except Exception as e:                               # noqa: BLE001
-            Log.warning("host_allgather tag=%r seq=%d rank=%d: key delete "
-                        "failed (%s: %s)", tag, seq, rank,
-                        type(e).__name__, e)
-    return out
+            _obs.inc("comm.barrier_failures")
+            Log.warning("host_allgather tag=%r seq=%d rank=%d: cleanup "
+                        "barrier failed (%s: %s); leaving key %s/%d for the "
+                        "coordination service to expire", tag, seq, rank,
+                        type(e).__name__, e, key, rank)
+        if barrier_ok:
+            try:
+                client.key_value_delete(f"{key}/{rank}")
+            except Exception as e:                           # noqa: BLE001
+                Log.warning("host_allgather tag=%r seq=%d rank=%d: key "
+                            "delete failed (%s: %s)", tag, seq, rank,
+                            type(e).__name__, e)
+        return out
 
 
 def distributed_client():
@@ -701,11 +713,15 @@ def init_distributed(config) -> bool:
     # pod-startup churn routinely loses the first coordination-service
     # handshake (the coordinator container comes up seconds after the
     # workers) — retry with backoff instead of dying on attempt one
+    from .. import observability as _obs
     try:
-        retry_call(_initialize,
-                   what=f"jax.distributed.initialize coordinator={coord} "
-                        f"rank={rank}/{len(machines)}")
+        with _obs.span("comm", op="init_distributed", coordinator=coord,
+                       rank=rank, world=len(machines)):
+            retry_call(_initialize,
+                       what=f"jax.distributed.initialize coordinator={coord} "
+                            f"rank={rank}/{len(machines)}")
     except Exception as e:
+        _obs.inc("comm.timeouts")
         raise CommTimeoutError(
             f"init_distributed: rank {rank} could not join the "
             f"coordination service at {coord} "

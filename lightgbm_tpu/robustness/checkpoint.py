@@ -62,8 +62,8 @@ VOLATILE_CONFIG_FIELDS = frozenset({
     "resume_from",
     # cluster wiring: the restarted pod gets fresh addresses/ports
     "machines", "machine_list_file", "local_listen_port", "time_out",
-    # profiling/telemetry
-    "tpu_time_tag", "tpu_profile_dir",
+    # profiling/telemetry (observability/: spans, exporters, profiler window)
+    "tpu_time_tag", "tpu_profile_dir", "tpu_profile_iters", "telemetry_dir",
 })
 
 
@@ -127,7 +127,11 @@ class CheckpointManager:
     # -------------------------------------------------------------- saving
 
     def save(self, payload: Dict) -> str:
-        """Write one snapshot atomically; returns the final path."""
+        """Write one snapshot atomically; returns the final path. The write
+        is a telemetry span + counter (``checkpoint.writes``): checkpoint
+        cadence and cost show up next to the training spans they interleave
+        with (docs/Observability.md)."""
+        from .. import observability as _obs
         os.makedirs(self.directory, exist_ok=True)
         existing = self.list_checkpoints()
         ckpt_id = (existing[-1][0] + 1) if existing else 1
@@ -137,17 +141,22 @@ class CheckpointManager:
         path = os.path.join(self.directory, f"ckpt_{ckpt_id:010d}.pkl")
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
-            with open(tmp, "wb") as fh:
-                pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
-                fh.flush()
-                os.fsync(fh.fileno())
-            os.replace(tmp, path)
+            with _obs.span("checkpoint", checkpoint_id=ckpt_id,
+                           iteration=payload.get("iteration")):
+                with open(tmp, "wb") as fh:
+                    pickle.dump(payload, fh,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, path)
         except OSError as e:
+            _obs.inc("checkpoint.write_failures")
             try:
                 os.unlink(tmp)
             except OSError:
                 pass
             raise CheckpointError(f"cannot write checkpoint {path}: {e}") from e
+        _obs.inc("checkpoint.writes")
         self._prune()
         self._sweep_tmp()
         return path

@@ -80,6 +80,8 @@ def retry_call(fn: Callable, *, what: str,
     jit = jitter if jitter is not None else \
         _env_float("LGBM_TPU_COMM_BACKOFF_JITTER", 0.25)
     rng = rng if rng is not None else random
+    from ..observability import get_registry
+    reg = get_registry()
     last: Optional[BaseException] = None
     for attempt in range(attempts):
         try:
@@ -90,10 +92,14 @@ def retry_call(fn: Callable, *, what: str,
                 break
             delay = min(base * (2.0 ** attempt), ceil)
             delay *= 1.0 + jit * rng.random()
+            # telemetry: every retry is counted (the JSONL stream carries
+            # the counter snapshot; the warning below carries the story)
+            reg.counter("comm.retries").inc()
             Log.warning("%s failed (attempt %d/%d: %s: %s) — retrying in "
                         "%.3fs", what, attempt + 1, attempts,
                         type(last).__name__, last, delay)
             sleep(delay)
+    reg.counter("comm.failures").inc()
     raise CommRetryError(
         f"{what} failed after {attempts} attempt(s): "
         f"{type(last).__name__}: {last}") from last

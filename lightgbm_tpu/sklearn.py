@@ -232,6 +232,16 @@ class LGBMModel(_SKBase):
             self.n_features_in_ = n_feat
         params = self._lgb_params()
         params.update(self.__dict__.pop("_fit_params_extra", {}))
+        # reference verbosity semantics: `silent`/`verbose` params reach
+        # Log.set_level (utils/log.py) — silent=True estimators train at
+        # warning level, verbose=-1 in **kwargs silences warnings too
+        _v = params.get("verbose", params.get("verbosity"))
+        if _v is not None:
+            try:
+                from .utils.log import Log
+                Log.set_level(int(_v))
+            except (TypeError, ValueError):
+                pass
         # callable objective: the reference sklearn wrapper accepts
         # objective(y_true, y_pred) -> (grad, hess) and routes it as a
         # custom fobj (sklearn.py:137-213 _ObjectiveFunctionWrapper)

@@ -9,9 +9,19 @@ from __future__ import annotations
 import logging
 
 _logger = logging.getLogger("lightgbm_tpu")
-if not _logger.handlers:
+
+# Handler-identity marker: the logging module's logger dict outlives this
+# module object, so a re-import (pytest importmode variations, importlib
+# reload) sees the logger again. Guarding on `_logger.handlers` truthiness
+# is wrong in both directions — a foreign handler (pytest's caplog, an
+# embedding app) would suppress OUR handler entirely, while our own handler
+# from a previous import is indistinguishable from one. Tag the handler and
+# guard on the tag.
+_HANDLER_TAG = "_lightgbm_tpu_handler"
+if not any(getattr(h, _HANDLER_TAG, False) for h in _logger.handlers):
     _h = logging.StreamHandler()
     _h.setFormatter(logging.Formatter("[LightGBM-TPU] [%(levelname)s] %(message)s"))
+    setattr(_h, _HANDLER_TAG, True)
     _logger.addHandler(_h)
     _logger.setLevel(logging.INFO)
 

@@ -66,64 +66,9 @@ class Timers:
 TIMERS = Timers()
 
 
-class PhaseBreakdown:
-    """Attributable per-phase device timing for bench.py: compile/warm-up
-    wall-clock vs steady-state wall-clock vs host-sync + recompile counts
-    (the latter two lifted from a ``RecompileGuard.report()``). Each bench
-    phase emits one of these into the BENCH json (``phase_timings``) so the
-    next perf session starts from a profile — which milliseconds are
-    one-time compiles, which are the steady loop, which are host round
-    trips — instead of a guess.
-
-        pb = PhaseBreakdown("headline")
-        with pb.compile_window():      # warm-up: compiles allowed
-            ...
-        with pb.steady_window(iters=12):
-            ...
-        pb.attach_guard(guard.report())
-        json["phase_timings"]["headline"] = pb.to_dict()
-    """
-
-    def __init__(self, name: str):
-        self.name = name
-        self.compile_s = 0.0
-        self.steady_s = 0.0
-        self.steady_iters = 0
-        self.guard_report: Dict = {}
-
-    @contextlib.contextmanager
-    def compile_window(self):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.compile_s += time.perf_counter() - t0
-
-    @contextlib.contextmanager
-    def steady_window(self, iters: int = 0):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.steady_s += time.perf_counter() - t0
-            self.steady_iters += iters
-
-    def attach_guard(self, report: Dict) -> None:
-        """Fold in a RecompileGuard report (host_syncs / cache misses)."""
-        self.guard_report = report or {}
-
-    def to_dict(self) -> Dict:
-        out = {"compile_s": round(self.compile_s, 3),
-               "steady_s": round(self.steady_s, 3),
-               "steady_iters": self.steady_iters}
-        if self.steady_iters and self.steady_s:
-            out["steady_s_per_iter"] = round(
-                self.steady_s / self.steady_iters, 4)
-        if self.guard_report:
-            out["host_syncs"] = self.guard_report.get("host_syncs")
-            out["post_warmup_cache_misses"] = self.guard_report.get(
-                "post_warmup_cache_misses")
-        return out
+# PhaseBreakdown moved to the observability subsystem (its numbers feed the
+# process-wide metrics registry); re-exported here for existing imports.
+from ..observability.phases import PhaseBreakdown  # noqa: E402,F401
 
 
 @contextlib.contextmanager

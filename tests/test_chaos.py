@@ -106,8 +106,10 @@ def test_resume_rejects_semantic_config_change(tmp_path):
 def _nan_params(policy, **extra):
     # objective="none" routes the chaos fobj's poisoned gradients into the
     # custom step; boost_from_average off keeps preds = raw scores
-    return dict(objective="none", verbose=-1, metric="none",
-                boost_from_average=False, nan_policy=policy, **extra)
+    out = dict(objective="none", verbose=-1, metric="none",
+               boost_from_average=False, nan_policy=policy)
+    out.update(extra)                    # extras may override (e.g. verbose)
+    return out
 
 
 def test_nan_policy_raise_fails_loudly_with_clean_state():
@@ -121,8 +123,11 @@ def test_nan_policy_raise_fails_loudly_with_clean_state():
 def test_nan_policy_skip_iter_drops_poisoned_iterations(caplog):
     X, y = _data()
     fobj = nan_gradient_fobj(bad_iters=[1, 3], mode="inf")
+    # verbose=0, not -1: this test ASSERTS the skip warnings are emitted,
+    # and verbosity is wired into Log.set_level now (verbose=-1 silences)
     with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
-        bst = lgb.train(_nan_params("skip_iter"), lgb.Dataset(X, label=y),
+        bst = lgb.train(_nan_params("skip_iter", verbose=0),
+                        lgb.Dataset(X, label=y),
                         num_boost_round=6, fobj=fobj)
     assert bst.num_trees() == 4            # 6 rounds - 2 dropped iterations
     assert np.isfinite(bst.predict(X)).all()
@@ -142,8 +147,10 @@ def test_nan_policy_skip_iter_aborts_on_deterministic_poison():
 def test_nan_policy_clip_sanitizes_and_continues(caplog):
     X, y = _data()
     fobj = nan_gradient_fobj(bad_iters=[1], frac=0.02)
+    # verbose=0: the clip warning must survive the wired verbosity
     with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
-        bst = lgb.train(_nan_params("clip"), lgb.Dataset(X, label=y),
+        bst = lgb.train(_nan_params("clip", verbose=0),
+                        lgb.Dataset(X, label=y),
                         num_boost_round=6, fobj=fobj)
     assert bst.num_trees() == 6            # nothing dropped
     assert np.isfinite(bst.predict(X)).all()
@@ -171,3 +178,79 @@ def test_dart_rejects_checkpointing(tmp_path):
                     keep_training_booster=True)
     with pytest.raises(LightGBMError, match="dart"):
         bst.save_checkpoint(str(tmp_path))
+
+
+# ------------------------------------------- telemetry under fault injection
+# (docs/Observability.md): the comm retry/timeout counters and the
+# nan_policy event counters must increment under ChaosKVClient injection
+# and land in the JSONL event stream.
+
+import pickle  # noqa: E402
+
+from lightgbm_tpu import observability as obs  # noqa: E402
+from lightgbm_tpu.observability.export import read_jsonl  # noqa: E402
+from lightgbm_tpu.parallel import comm  # noqa: E402
+from lightgbm_tpu.robustness.chaos import (ChaosKVClient,  # noqa: E402
+                                           ChaosPlan, FakeKVStore)
+from lightgbm_tpu.robustness.retry import CommTimeoutError  # noqa: E402
+
+
+@pytest.fixture
+def telemetry(tmp_path):
+    obs.reset_for_tests()
+    obs.configure(telemetry_dir=str(tmp_path))
+    yield obs
+    obs.reset_for_tests()
+
+
+def _preloaded_store(tag, peer_obj):
+    store = FakeKVStore()
+    key = f"lgbm_hostgather/{tag}/{comm._host_allgather_seq[0]}"
+    store.preload(f"{key}/1", pickle.dumps(peer_obj))
+    return store
+
+
+def test_comm_fault_counters_land_in_jsonl(telemetry):
+    # transient injected drop -> one retry, gather still succeeds
+    chaos = ChaosKVClient(_preloaded_store("tel1", "peer"),
+                          ChaosPlan(seed=11, drop_gets=(0,)))
+    out = comm.host_allgather("mine", "tel1", timeout_ms=500,
+                              client=chaos, rank=0, world=2)
+    assert out == ["mine", "peer"]
+    # permanent injected drops -> exhausted retries -> CommTimeoutError
+    chaos2 = ChaosKVClient(_preloaded_store("tel2", "peer"),
+                           ChaosPlan(seed=12, drop_gets=(0, 1, 2)))
+    with pytest.raises(CommTimeoutError):
+        comm.host_allgather("mine", "tel2", timeout_ms=300,
+                            client=chaos2, rank=0, world=2)
+    snap = obs.snapshot()
+    assert snap["counters"]["comm.retries"] >= 1
+    assert snap["counters"]["comm.timeouts"] >= 1
+    assert snap["counters"]["comm.failures"] >= 1
+    assert snap["counters"]["comm.host_allgather"] == 2
+    obs.flush()
+    recs = read_jsonl(obs.jsonl_path())
+    counters = [r for r in recs if r.get("type") == "counters"][-1]
+    assert counters["counters"]["comm.retries"] >= 1
+    assert counters["counters"]["comm.timeouts"] >= 1
+    spans = [r for r in recs
+             if r.get("type") == "span" and r["name"] == "comm"]
+    assert spans and all(s["args"]["op"] == "host_allgather" for s in spans)
+    assert any(s["args"].get("error") for s in spans)   # the timed-out one
+
+
+def test_nan_policy_event_counters_land_in_jsonl(telemetry):
+    X, y = _data()
+    fobj = nan_gradient_fobj(bad_iters=[1, 3], mode="inf")
+    lgb.train(_nan_params("skip_iter"), lgb.Dataset(X, label=y),
+              num_boost_round=6, fobj=fobj)
+    snap = obs.snapshot()
+    assert snap["counters"]["nan.events"] == 2
+    assert snap["counters"]["nan.skipped_iters"] == 2
+    recs = read_jsonl(obs.jsonl_path())      # engine.train flushed already
+    evs = [r for r in recs
+           if r.get("type") == "event" and r["name"] == "nan_policy"]
+    assert len(evs) == 2
+    assert all(e["args"]["policy"] == "skip_iter" for e in evs)
+    counters = [r for r in recs if r.get("type") == "counters"][-1]
+    assert counters["counters"]["nan.skipped_iters"] == 2

@@ -57,7 +57,9 @@ def test_whitespace_feature_names_warn_on_save(caplog):
     rng = np.random.RandomState(13)
     X = rng.rand(300, 3)
     ds = lgb.Dataset(X, label=X[:, 0], feature_name=["a b", "x:y", "ok"])
-    b = lgb.train({"objective": "regression", "verbose": -1, "num_leaves": 5},
+    # verbose=0, not -1: the wired verbosity would otherwise leave the
+    # logger at fatal-only and swallow the warning this test asserts
+    b = lgb.train({"objective": "regression", "verbose": 0, "num_leaves": 5},
                   ds, num_boost_round=2)
     import io
     import logging

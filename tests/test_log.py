@@ -1,0 +1,145 @@
+"""Verbosity wiring + logger handler hygiene (utils/log.py).
+
+The reference's verbosity semantics (<0 fatal-only, 0 warnings, 1 info,
+>1 debug — include/LightGBM/utils/log.h) are wired from ``config.verbose``
+into ``Log.set_level`` by every training entry point (engine.train,
+cli.py, sklearn.py); and the module-import handler attach guards on
+handler IDENTITY, not ``handlers`` truthiness, so pytest importmode
+variations / foreign handlers can neither duplicate nor suppress it."""
+import importlib
+import logging
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils import log as log_mod
+
+
+def _data(n=300, f=4, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    return X, y
+
+
+def _logger():
+    return logging.getLogger("lightgbm_tpu")
+
+
+@pytest.fixture(autouse=True)
+def restore_level():
+    lvl = _logger().level
+    yield
+    _logger().setLevel(lvl)
+
+
+# ----------------------------------------------------------- level semantics
+
+def test_set_level_mapping():
+    log_mod.Log.set_level(-1)
+    assert _logger().level == logging.CRITICAL
+    log_mod.Log.set_level(0)
+    assert _logger().level == logging.WARNING
+    log_mod.Log.set_level(1)
+    assert _logger().level == logging.INFO
+    log_mod.Log.set_level(2)
+    assert _logger().level == logging.DEBUG
+
+
+def test_train_verbose_minus1_silences_warnings(caplog):
+    """verbose=-1 must silence even construction-time warnings (the unknown-
+    parameter warning fires inside Config.from_params)."""
+    X, y = _data()
+    with caplog.at_level(logging.DEBUG, logger="lightgbm_tpu"):
+        lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 4,
+                   "metric": "none", "definitely_not_a_param": 1},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    assert not [r for r in caplog.records
+                if "Unknown parameter" in r.getMessage()]
+
+
+def test_train_verbose0_keeps_warnings(caplog):
+    X, y = _data()
+    with caplog.at_level(logging.DEBUG, logger="lightgbm_tpu"):
+        lgb.train({"objective": "binary", "verbose": 0, "num_leaves": 4,
+                   "metric": "none", "definitely_not_a_param": 1},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    assert [r for r in caplog.records
+            if "Unknown parameter" in r.getMessage()]
+
+
+def test_train_verbose2_enables_debug(caplog):
+    """verbose=2 -> debug level: the kernel-resolution Log.debug line from
+    booster construction must be emitted."""
+    X, y = _data()
+    with caplog.at_level(logging.DEBUG, logger="lightgbm_tpu"):
+        lgb.train({"objective": "binary", "verbose": 2, "num_leaves": 4,
+                   "metric": "none"},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    debugs = [r for r in caplog.records if r.levelno == logging.DEBUG]
+    assert any("resolved to" in r.getMessage() for r in debugs)
+
+
+def test_verbosity_alias_is_honored():
+    X, y = _data()
+    lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 4,
+               "metric": "none"},
+              lgb.Dataset(X, label=y), num_boost_round=1)
+    assert _logger().level == logging.CRITICAL
+
+
+def test_sklearn_silent_sets_warning_level():
+    X, y = _data()
+    lgb.LGBMRegressor(n_estimators=1, silent=True, num_leaves=4,
+                      min_child_samples=5).fit(X, y)
+    assert _logger().level == logging.WARNING
+
+
+# ------------------------------------------------------------ handler guard
+
+def _tagged_handlers():
+    return [h for h in _logger().handlers
+            if getattr(h, "_lightgbm_tpu_handler", False)]
+
+
+@pytest.fixture
+def reloadable_log():
+    """Reload utils.log safely: re-execution rebinds Log/LightGBMError to
+    NEW class objects in the (shared) module namespace, and the old Log
+    class — still referenced by every other module — resolves
+    ``LightGBMError`` from that namespace at raise time. Restore the
+    original bindings afterwards so exception identity stays consistent
+    for the rest of the test session."""
+    orig = {name: getattr(log_mod, name)
+            for name in ("Log", "LightGBMError")}
+    yield log_mod
+    for name, val in orig.items():
+        setattr(log_mod, name, val)
+
+
+def test_exactly_one_tagged_handler_installed():
+    assert len(_tagged_handlers()) == 1
+
+
+def test_reimport_does_not_duplicate_handler(reloadable_log):
+    before = _tagged_handlers()
+    assert len(before) == 1
+    importlib.reload(reloadable_log)
+    importlib.reload(reloadable_log)
+    after = _tagged_handlers()
+    assert len(after) == 1
+    assert after[0] is before[0]        # the original instance survived
+
+
+def test_foreign_handler_does_not_suppress_ours(reloadable_log):
+    """The historical `if not _logger.handlers` guard skipped OUR handler
+    whenever anything else (caplog, an embedding app) attached one first —
+    the identity guard must still install exactly one tagged handler."""
+    foreign = logging.NullHandler()
+    _logger().addHandler(foreign)
+    try:
+        importlib.reload(reloadable_log)
+        assert len(_tagged_handlers()) == 1
+    finally:
+        _logger().removeHandler(foreign)
