@@ -10,16 +10,31 @@
 # compile cache in .jax_cache; `check-fast` is ~4 min cold.
 PYTEST := python -m pytest -q
 
-# Static JAX/TPU hygiene pass (rules R001-R007, see docs/Static-Analysis.md).
+# Static JAX/TPU hygiene pass (rules R001-R008, see docs/Static-Analysis.md).
 # Exits non-zero on any finding not covered by tpu_lint_baseline.json.
 lint:
 	python -m lightgbm_tpu.analysis lightgbm_tpu/
 
 # CI gate: lint + tier-1 tests + the recompile guard on a 5-iter smoke run
-# (which now also asserts a checkpoint save/resume cycle stays recompile-free).
+# (which also asserts checkpoint save/resume stays recompile-free and pins
+# the fused step's FLOPs/bytes to golden values) + the perf-ledger diff.
 verify: lint
 	env JAX_PLATFORMS=cpu $(PYTEST) tests/ -m 'not slow'
 	python bench.py --smoke
+	$(MAKE) bench-diff
+
+# Perf regression gate (docs/TPU-Performance.md): assert the committed
+# PERF_LEDGER.json matches the checked-in BENCH_*/MULTICHIP_* history (no
+# drift), then judge the newest BENCH result against best-known values —
+# exits nonzero on a throughput/recompile/host-sync/HBM/cost regression.
+bench-diff:
+	python -m lightgbm_tpu.observability.ledger --check
+	python bench.py --compare
+
+# One-shot ledger rebuild from the checked-in history files; commit the
+# regenerated PERF_LEDGER.json alongside any new BENCH_r*/MULTICHIP_r* file.
+ledger:
+	python -m lightgbm_tpu.observability.ledger --rebuild
 
 # Fault-injection suite (docs/Fault-Tolerance.md): KV delay/drop/corruption
 # through the chaos harness + all three nan_policy branches + kill-and-resume.
@@ -50,4 +65,4 @@ trace:
 	env LGBM_TPU_TELEMETRY_DIR=$(CURDIR)/.telemetry python bench.py --smoke
 	@echo "trace: $$(ls -1t .telemetry/trace_*.json | head -1)"
 
-.PHONY: lint verify check-fast check capi bench-cpu chaos trace
+.PHONY: lint verify check-fast check capi bench-cpu chaos trace bench-diff ledger

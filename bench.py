@@ -312,8 +312,10 @@ def run_sparse_phase():
         el = time.perf_counter() - t0
         out[f"sparse_mrow_tree_per_s_{tag}"] = _round_tp(
             n_rows * timed / el / 1e6)
-        stats = jax.local_devices()[0].memory_stats() or {}
-        peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+        # shared backend-fallback helper (observability/memory.py) — the one
+        # home of the memory_stats() read
+        from lightgbm_tpu.observability.memory import device_memory
+        peak = device_memory().get("peak_bytes")
         if peak:
             out[f"sparse_hbm_peak_gb_{tag}"] = round(peak / 2 ** 30, 2)
         del b, ds
@@ -374,6 +376,14 @@ def run_bench(deadline, attempt=0, platform=None):
     import lightgbm_tpu as lgb
     from lightgbm_tpu import observability as obs
     obs.maybe_configure_from_env()       # LGBM_TPU_TELEMETRY_DIR
+    if os.environ.get("LGBM_TPU_BENCH_COSTS") == "1":
+        # compile-time cost capture for every dispatch site this run
+        # compiles (observability/costs.py; reports land in the telemetry
+        # block below and in the perf ledger). Opt-in: through a COLD
+        # tunnel the duplicate lower+compile of the 10.5M-row step costs
+        # minutes — with the warm persistent cache above it is a disk hit.
+        from lightgbm_tpu.observability import costs as obs_costs
+        obs_costs.configure(enabled=True)
 
     kernel = os.environ.get("LGBM_TPU_BENCH_KERNEL", "auto")
     if attempt > 0:
@@ -550,11 +560,11 @@ def run_bench(deadline, attempt=0, platform=None):
         "auc_parity_gap": None,
     }
     # device memory alongside throughput (the reference reports peak RES /
-    # GPU memory: docs/Experiments.rst:158, docs/GPU-Performance.rst:183)
+    # GPU memory: docs/Experiments.rst:158, docs/GPU-Performance.rst:183) —
+    # via the shared backend-fallback helper (observability/memory.py)
     try:
-        import jax
-        stats = jax.local_devices()[0].memory_stats() or {}
-        peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+        from lightgbm_tpu.observability.memory import device_memory
+        peak = device_memory().get("peak_bytes")
         if peak:
             result["hbm_peak_gb"] = round(peak / 2 ** 30, 2)
     except Exception:                                        # noqa: BLE001
@@ -771,6 +781,10 @@ def run_bench(deadline, attempt=0, platform=None):
                 "trace_file": trace_file,
                 "events_file": obs.jsonl_path(),
             }
+            if snap.get("cost_reports"):
+                # compiled-step cost reports ride in the BENCH json so the
+                # perf ledger can flag cost-model drift across rounds
+                result["telemetry"]["cost_reports"] = snap["cost_reports"]
             _PARTIAL["result"] = dict(result)
     except Exception as e:                                   # noqa: BLE001
         result["telemetry_error"] = str(e)[:200]
@@ -1012,8 +1026,12 @@ def run_smoke():
     compile cache round-trips: a child training run populates a fresh
     cache dir, and an identical second run compiles nothing (writes no new
     cache entries) — the cache-hit path that keeps repeated remote-TPU
-    compiles out of bench budgets. Prints one JSON line; exit 0 iff the
-    guards hold."""
+    compiles out of bench budgets. Cost capture (observability/costs.py)
+    is enabled for the WHOLE run: every guarded loop must stay
+    recompile-free and host-sync-free with capture on, and the fused
+    step's compile-time FLOPs/bytes are pinned to the goldens in
+    tests/fixtures/cost_golden.json at the end. Prints one JSON line;
+    exit 0 iff the guards hold."""
     from lightgbm_tpu.utils.hermetic import force_cpu_backend
     force_cpu_backend()
     import shutil
@@ -1021,6 +1039,7 @@ def run_smoke():
 
     import lightgbm_tpu as lgb
     from lightgbm_tpu import observability as obs
+    from lightgbm_tpu.observability import costs as obs_costs
     from lightgbm_tpu.analysis.guards import GuardViolation, RecompileGuard
 
     # telemetry is ON for the whole smoke run (the acceptance contract:
@@ -1033,6 +1052,11 @@ def run_smoke():
         tel_tmp = tempfile.mkdtemp(prefix="lgbm_smoke_telemetry_")
         tel_dir = tel_tmp
     obs.configure(telemetry_dir=tel_dir)
+    # cost capture is ON for the whole smoke run too: every guarded loop
+    # below must stay recompile-free and host-sync-free WITH capture
+    # enabled (capture happens at first dispatch, before mark_warm), and
+    # the fused step's FLOPs/bytes are pinned to goldens at the end
+    obs_costs.configure(enabled=True)
 
     n_rows = int(os.environ.get("LGBM_TPU_SMOKE_ROWS", "20000"))
     iters = int(os.environ.get("LGBM_TPU_SMOKE_ITERS", "5"))
@@ -1221,6 +1245,36 @@ def run_smoke():
         if tel_tmp:
             shutil.rmtree(tel_tmp, ignore_errors=True)
 
+    # ---- golden cost pin for the fused step (observability/costs.py) -------
+    # The fused train step's compile-time FLOPs/bytes-accessed must sit
+    # inside the tolerance band of the committed goldens
+    # (tests/fixtures/cost_golden.json) — a silent cost regression (an
+    # accidental extra full-N pass, a dtype widening, a lost donation)
+    # moves them 2x and fails CI here before any TPU sees it.
+    cost_ok, cost_err = True, None
+    cost_pin = {}
+    try:
+        rep = obs_costs.report("train_step.k2")
+        if rep is None or rep.get("error"):
+            raise RuntimeError(
+                f"no cost report captured for the fused step: {rep}")
+        cost_pin = {k: rep.get(k) for k in
+                    ("flops", "bytes_accessed", "peak_hbm_bytes")}
+        if n_rows == 20000:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "tests",
+                    "fixtures", "cost_golden.json")) as fh:
+                golden = json.load(fh)["smoke_train_step_k2"]
+            bad = obs_costs.drift(rep, golden)
+            if bad:
+                raise RuntimeError(
+                    f"fused-step cost drifted from golden: {bad}")
+        else:
+            cost_pin["golden_skipped"] = \
+                f"non-default smoke shape (rows={n_rows})"
+    except Exception as e:            # noqa: BLE001 — any failure fails CI
+        cost_ok, cost_err = False, f"{type(e).__name__}: {e}"
+
     out = {"metric": "smoke_recompile_guard", "rows": n_rows, "iters": iters,
            "post_warmup_cache_misses": report["post_warmup_cache_misses"],
            "host_syncs": report["host_syncs"],
@@ -1229,7 +1283,9 @@ def run_smoke():
            "telemetry_ok": tel_ok,
            "telemetry_post_warmup_cache_misses": tel_misses,
            "telemetry_dir": None if tel_tmp else tel_dir,
-           "ok": ok and resume_ok and cache_ok and tel_ok}
+           "cost_pin_ok": cost_ok,
+           "cost_pin": cost_pin,
+           "ok": ok and resume_ok and cache_ok and tel_ok and cost_ok}
     if err:
         out["error"] = err[:300]
     if resume_err:
@@ -1238,8 +1294,52 @@ def run_smoke():
         out["compile_cache_error"] = cache_err[:300]
     if tel_err:
         out["telemetry_error"] = tel_err[:300]
+    if cost_err:
+        out["cost_pin_error"] = cost_err[:300]
     print(json.dumps(out))
-    return 0 if (ok and resume_ok and cache_ok and tel_ok) else 1
+    return 0 if (ok and resume_ok and cache_ok and tel_ok and cost_ok) else 1
+
+
+def run_compare(argv):
+    """`bench.py --compare [result.json]`: flag perf regressions of a bench
+    result against the checked-in history (observability/ledger.py).
+
+    The candidate defaults to the newest committed ``BENCH_r*.json`` (its
+    own entry is excluded from the best-known computation, so re-judging
+    history never self-compares). Checks: throughput vs best-known for the
+    same platform/rows, post-warm-up recompiles, headline host syncs, peak
+    HBM, and compiled cost-model drift. Prints ONE JSON line; exit 0 clean,
+    2 on any regression — the `make bench-diff` / `make verify` gate. This
+    is a pure file comparison: no backend, no training, so it runs anywhere
+    in milliseconds."""
+    import glob as _glob
+
+    from lightgbm_tpu.observability import ledger as perf_ledger
+    repo = os.path.dirname(os.path.abspath(__file__))
+    idx = argv.index("--compare")
+    explicit = [a for a in argv[idx + 1:] if not a.startswith("-")]
+    path = explicit[0] if explicit else None
+    if path is None:
+        hist = sorted(_glob.glob(os.path.join(repo, "BENCH_r*.json")))
+        if not hist:
+            print(json.dumps({"metric": "perf_ledger_compare", "ok": False,
+                              "error": "no BENCH_r*.json history to compare "
+                                       "against"}))
+            return 2
+        path = hist[-1]
+    payload = perf_ledger.payload_of(path)
+    entries = perf_ledger.load_history(repo)
+    problems, notes = perf_ledger.compare(
+        payload or {}, entries, exclude_source=os.path.basename(path))
+    out = {"metric": "perf_ledger_compare",
+           "candidate": os.path.basename(path),
+           "value": (payload or {}).get("value"),
+           "platform": (payload or {}).get("platform"),
+           "rows": (payload or {}).get("rows"),
+           "problems": problems, "notes": notes,
+           "ok": not problems}
+    print(json.dumps(out))
+    return 0 if not problems else 2
 
 
 if __name__ == "__main__":
@@ -1247,5 +1347,7 @@ if __name__ == "__main__":
         run_sparse_phase()
     elif "--smoke" in sys.argv:
         sys.exit(run_smoke())
+    elif "--compare" in sys.argv:
+        sys.exit(run_compare(sys.argv))
     else:
         main()

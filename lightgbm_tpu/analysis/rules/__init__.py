@@ -7,6 +7,7 @@ from .pallas_shapes import PallasShapeRule         # R004
 from .static_args import StaticArgsRule            # R005
 from .import_exec import ImportExecRule            # R006
 from .sort_in_loop import SortInLoopRule           # R007
+from .ad_hoc_timing import AdHocTimingRule         # R008
 
 _RULES = None
 
@@ -16,5 +17,5 @@ def active_rules():
     if _RULES is None:
         _RULES = [ControlFlowRule(), HostSyncRule(), DtypePromotionRule(),
                   PallasShapeRule(), StaticArgsRule(), ImportExecRule(),
-                  SortInLoopRule()]
+                  SortInLoopRule(), AdHocTimingRule()]
     return _RULES

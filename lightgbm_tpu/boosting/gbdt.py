@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import observability as obs
+from ..observability import costs as obs_costs
 from ..config import Config
 from ..dataset import ConstructedDataset, Metadata, MetadataDuckTyping
 from ..grower import GrowerSpec, TreeArrays, grow_tree, waves_for_tree
@@ -561,6 +562,15 @@ class GBDT:
         obs.event("booster_init", kernel=hist_kernel, tree_batch=tb,
                   rows=int(N), features=int(F), num_leaves=int(num_leaves),
                   strategy=self.pctx.strategy, nan_policy=self.nan_policy)
+        # MULTICHIP story: analytic per-wave collective payload estimates
+        # (parallel/comm.py collective_bytes) — host arithmetic at
+        # construction, so the comm budget is inspectable before any
+        # distributed dispatch runs
+        comm_bytes = self.comm.collective_bytes(self.spec.hist_slots, Bpad)
+        for cname, nbytes in comm_bytes.items():
+            reg.gauge(f"comm.bytes_per_wave.{cname}").set(nbytes)
+        if comm_bytes:
+            obs.event("comm_cost", strategy=self.pctx.strategy, **comm_bytes)
 
     # ------------------------------------------------------------------ setup
 
@@ -868,6 +878,24 @@ class GBDT:
         consts, valid_Xb = self._step_consts()
         return consts, valid_Xb, valid_scores
 
+    def _capture_step_cost(self, site: str, fn, args, batch: int) -> None:
+        """Cost-report leg of the dispatch protocol (observability/costs.py,
+        gated on ``costs.enabled()`` by the callers): lower+compile the SAME
+        jitted step with the live arguments once per executable and publish
+        FLOPs / bytes-accessed / argument+temp HBM. Compile-time only — no
+        steady-state recompile, no host sync (``bench.py --smoke`` A/Bs the
+        fused loop with capture on)."""
+        obs_costs.capture_jit(
+            site, fn, args,
+            dims=dict(rows=int(self.num_data),
+                      rows_padded=int(self.num_data_padded),
+                      features=int(self.spec.num_features),
+                      num_leaves=int(self.spec.num_leaves),
+                      hist_slots=int(self.spec.hist_slots),
+                      tree_batch=int(batch), num_models=int(self.num_models),
+                      kernel=self.spec.hist_kernel,
+                      strategy=self.pctx.strategy))
+
     def _run_step(self, score, shrinkage: float, custom_gh=None):
         """Dispatch one compiled step against current state; returns new score
         and per-valid score tuples (device)."""
@@ -880,8 +908,16 @@ class GBDT:
                 self._custom_step_fn = self._make_step(custom_grads=True)
             fn, extra = self._custom_step_fn, custom_gh
         consts, valid_Xb, valid_scores = self._dispatch_prep(shrinkage)
-        outs = fn(consts, valid_Xb, score, valid_scores, self.bag_mask,
-                  self._rng_key, self._iter_dev, self._shrink_cache[1], *extra)
+        args = (consts, valid_Xb, score, valid_scores, self.bag_mask,
+                self._rng_key, self._iter_dev, self._shrink_cache[1], *extra)
+        if obs_costs.enabled():
+            # compile-time cost report of THIS dispatch signature — captured
+            # once per (site, executable), before the first call so the AOT
+            # compile primes the persistent cache the dispatch then hits
+            self._capture_step_cost(
+                "train_step.k1" + (".custom" if custom_gh is not None
+                                   else ""), fn, args, 1)
+        outs = fn(*args)
         nf = None
         if self.nan_policy != "none":
             score, out_valid, self.bag_mask, trees, nl, self._iter_dev, nf = outs
@@ -998,8 +1034,11 @@ class GBDT:
             self._batch_step_fns[n] = fn
         consts, valid_Xb, valid_scores = self._dispatch_prep(
             self._step_shrinkage())
-        outs = fn(consts, valid_Xb, self.score, valid_scores, self.bag_mask,
-                  self._rng_key, self._iter_dev, self._shrink_cache[1])
+        args = (consts, valid_Xb, self.score, valid_scores, self.bag_mask,
+                self._rng_key, self._iter_dev, self._shrink_cache[1])
+        if obs_costs.enabled():
+            self._capture_step_cost(f"train_step.k{n}", fn, args, n)
+        outs = fn(*args)
         nf = None
         if self.nan_policy != "none":
             score, out_valid, self.bag_mask, trees, nl, self._iter_dev, nf = outs

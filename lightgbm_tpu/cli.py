@@ -48,6 +48,12 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
             else:
                 tok = tok.replace("-", "_")
         if "=" not in tok:
+            if tok == "dump_snapshot":
+                # bare `--dump-snapshot`: write observability.snapshot() to
+                # the default file at train end (an explicit
+                # `--dump-snapshot=FILE` names the destination instead)
+                cli.setdefault("dump_snapshot", "observability_snapshot.json")
+                continue
             # convenience subcommand form: `cli train config=...` ==
             # `cli task=train config=...` (the reference CLI is strictly
             # key=value, application.cpp:48-81; the bare form costs

@@ -332,6 +332,20 @@ class Config:
     # env LGBM_TPU_TELEMETRY_DIR; empty + no env = span recording disabled
     # (the metrics registry is always live)
     telemetry_dir: str = ""
+    # compile-time cost capture (observability/costs.py): lower+compile each
+    # dispatch site once with the live arguments and publish
+    # cost_analysis()/memory_analysis() — FLOPs, bytes accessed, argument/
+    # temp HBM — as cost.<site>.* gauges, into snapshot(), and as Perfetto
+    # trace metadata. Off by default (it duplicates trace work and, without
+    # the persistent compile cache, the XLA compile); env
+    # LGBM_TPU_COST_ANALYSIS=1 also enables. bench.py --smoke runs with it
+    # on and pins the fused step's FLOPs/bytes to golden values.
+    tpu_cost_analysis: bool = False
+    # write observability.snapshot() (counters/gauges/histograms + cost and
+    # memory reports) to this JSON file at train end; "" = off — but with
+    # telemetry_dir set a snapshot_<pid>.json always lands there. CLI:
+    # --dump-snapshot[=FILE].
+    dump_snapshot: str = ""
     # boosting iterations fused into ONE jit dispatch via lax.scan (built-in
     # objectives only): score updates, tree growth, and leaf application for
     # K trees never leave HBM, and the host loop pays dispatch + sync cost

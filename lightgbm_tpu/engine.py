@@ -97,6 +97,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.add_valid(vs, name)
         names.append(name)
 
+    # ---- HBM pre-flight budget (observability/memory.py) -------------------
+    # analytic wave-loop residency — pure host arithmetic, after the valid
+    # sets are attached so their device footprint counts: one budget line,
+    # plus a warning when the estimate exceeds device_memory() capacity
+    from .observability import memory as obs_memory
+    try:
+        obs_memory.log_budget(obs_memory.hbm_preflight(booster._gbdt))
+    except Exception as e:                                   # noqa: BLE001
+        Log.debug("HBM pre-flight estimate failed: %s: %s",
+                  type(e).__name__, e)
+
     # continued training: seed scores with the loaded model's raw predictions
     # (reference: input_model re-prediction, application.cpp:90-93) and keep
     # its trees so the saved model contains the full forest
@@ -237,6 +248,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
     profile_window = ProfileWindow(config.tpu_profile_iters, _profile_out)
     whole_run_profile = "" if profile_window.enabled \
         else config.tpu_profile_dir
+    # compile-time cost capture (observability/costs.py) is opt-in — it
+    # duplicates trace/compile work at every dispatch site it reports on.
+    # The param scopes capture to THIS run: the prior state (env knob, an
+    # explicit configure by the bench/smoke harness) is restored in the
+    # finally below. Enabled DIRECTLY before the try so no setup failure
+    # between enable and restore can leak capture into later fits.
+    from .observability import costs as obs_costs
+    _costs_was_enabled = None
+    if config.tpu_cost_analysis:
+        _costs_was_enabled = obs_costs.enabled()
+        obs_costs.configure(enabled=True)
     try:
         with maybe_xla_trace(whole_run_profile), \
                 obs.span("train", rows=gbdt.num_data, n_rounds=n_rounds,
@@ -286,6 +308,24 @@ def train(params: Dict[str, Any], train_set: Dataset,
         except Exception as e:                               # noqa: BLE001
             Log.warning("telemetry flush failed: %s: %s",
                         type(e).__name__, e)
+        # train-end snapshot dump (cost/memory reports included): the
+        # explicit dump_snapshot path AND — whenever a telemetry dir is
+        # configured — a snapshot_<pid>.json in that dir, unconditionally,
+        # so harvest windows capture it without code edits
+        try:
+            snap_paths = []
+            if config.dump_snapshot:
+                snap_paths.append(config.dump_snapshot)
+            if obs.telemetry_dir():
+                snap_paths.append(os.path.join(
+                    obs.telemetry_dir(), f"snapshot_{os.getpid()}.json"))
+            for snap_path in snap_paths:
+                obs.write_snapshot(snap_path)
+        except Exception as e:                               # noqa: BLE001
+            Log.warning("snapshot dump failed: %s: %s",
+                        type(e).__name__, e)
+        if _costs_was_enabled is False:
+            obs_costs.configure(enabled=False)
 
     booster._finalize()
     TIMERS.dump()       # reference TIMETAG destructor dump (gbdt.cpp)

@@ -15,6 +15,15 @@ One subsystem for every runtime signal the boosting stack produces:
   ``telemetry_dir``; ``snapshot()`` is the point-in-time serving API.
 - ``ProfileWindow`` (profiler.py) — optional ``jax.profiler`` capture of an
   iteration range (``tpu_profile_iters=start:stop``).
+- cost reports (costs.py)         — compile-time ``cost_analysis()`` /
+  ``memory_analysis()`` capture per dispatch site (opt-in:
+  ``tpu_cost_analysis`` / ``LGBM_TPU_COST_ANALYSIS``), published as
+  ``cost.<site>.*`` gauges, into ``snapshot()``, and as Perfetto metadata.
+- HBM accounting (memory.py)      — ``device_memory()`` stats helper and
+  the analytic pre-flight residency estimate ``engine.train`` budgets
+  against before the first compile.
+- perf ledger (ledger.py)         — normalized BENCH/MULTICHIP history +
+  regression compare (``bench.py --compare`` / ``make bench-diff``).
 
 The module singletons are process-wide on purpose: a training run, the
 bench harness, and a serving probe all read the same registry. Everything
@@ -118,11 +127,29 @@ def jsonl_path() -> Optional[str]:
 
 def snapshot() -> Dict:
     """Point-in-time metrics snapshot (the serving API): registry contents
-    plus tracer bookkeeping."""
+    plus tracer bookkeeping, the captured compile-time cost reports
+    (costs.py), and the device memory stats (memory.py — ``{}``-safe in a
+    jax-free process, so this stays callable from anywhere)."""
     snap = _registry.snapshot()
     snap["spans_recorded"] = len(_tracer.events())
     snap["spans_dropped"] = _tracer.dropped
+    from . import costs as _costs
+    cost_reports = _costs.reports()
+    if cost_reports:
+        snap["cost_reports"] = cost_reports
+    from .memory import device_memory
+    dm = device_memory()
+    if dm:
+        snap["device_memory"] = dm
     return snap
+
+
+def write_snapshot(path: str) -> str:
+    """Write ``snapshot()`` to ``path`` as JSON (atomic) — the
+    ``--dump-snapshot`` / train-end artifact harvest windows collect."""
+    from .export import atomic_write_json
+    return atomic_write_json(path, snapshot(), indent=1, sort_keys=True,
+                             trailing_newline=True)
 
 
 def flush() -> Optional[str]:
@@ -139,16 +166,24 @@ def flush() -> Optional[str]:
                for ev in new]
     records.append(dict(snapshot(), type="counters"))
     JsonlWriter(jsonl_path()).append(records)
-    return write_chrome_trace(
-        _tracer.events(), trace_path(),
-        metadata={"epoch_unix": _tracer.epoch_unix()})
+    from . import costs as _costs
+    metadata = {"epoch_unix": _tracer.epoch_unix()}
+    cost_reports = _costs.reports()
+    if cost_reports:
+        # compile-time cost reports ride as trace metadata so the Perfetto
+        # artifact is self-describing about what the traced step costs
+        metadata["cost_reports"] = cost_reports
+    return write_chrome_trace(_tracer.events(), trace_path(),
+                              metadata=metadata)
 
 
 def reset_for_tests() -> None:
     """Full reset of the process-wide singletons (test isolation)."""
+    from . import costs as _costs
     _registry.reset()
     _tracer.reset()
     _tracer.enabled = False
     _state["dir"] = None
     _state["jsonl_cursor"] = 0
     _state["env_checked"] = False
+    _costs.reset_for_tests()

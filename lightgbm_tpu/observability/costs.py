@@ -1,0 +1,242 @@
+"""Compile-time cost introspection: XLA cost/memory reports per dispatch site.
+
+The runtime half of the observability stack (spans, registry, Perfetto —
+PR 5) says *when* the compiled step ran; this module says *what it costs*:
+FLOPs, bytes accessed, and the compiled executable's argument/temp/output
+HBM, captured from ``lowered.compile().cost_analysis()`` /
+``memory_analysis()`` at every major dispatch site (the fused train step in
+boosting/gbdt.py, the histogram kernels via ops/histogram.py
+``histogram_cost_report``, batch predict in ops/predict.py) plus analytic
+per-collective byte estimates from parallel/comm.py ``collective_bytes``.
+
+Capture contract (the same one the span tracer honors):
+
+- **compile/trace time only** — ``capture_jit`` lowers + compiles the SAME
+  jitted callable with the live dispatch arguments ONCE per callable and
+  never again, so the steady-state loop stays recompile-free and
+  host-sync-free (``bench.py --smoke`` A/Bs the fused step with capture on).
+  With the persistent compile cache enabled the duplicate XLA compile is a
+  cache hit (the AOT compile and the first dispatch lower to identical HLO);
+  the capture does NOT populate the jit fastpath cache, so RecompileGuard
+  ``_cache_size()`` deltas are untouched.
+- **off by default** — compiling everything twice would tax every tiny test
+  training; enable via ``costs.configure(enabled=True)``, config
+  ``tpu_cost_analysis=true`` (engine.train), or env
+  ``LGBM_TPU_COST_ANALYSIS=1``. ``bench.py --smoke`` runs with it on and
+  pins the fused step's FLOPs/bytes to golden values (``drift`` below) so a
+  silent cost regression fails tier-1.
+- **graceful fallback** — a backend returning ``None`` (or raising
+  ``Unimplemented``) from either analysis yields a report with ``None``
+  fields, never an exception; capture failures are recorded in the report's
+  ``error`` field and never take training down.
+
+Reports land in three places: the in-module report table (``reports()``,
+folded into ``observability.snapshot()`` — the serving probe sees them),
+the metrics registry as ``cost.<site>.<field>`` gauges, and the Perfetto
+trace's ``otherData.cost_reports`` metadata at flush time.
+
+jax is imported lazily: the module stays importable in jax-free
+environments (the lint CLI path) like the rest of the subsystem.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+ENV_COST_ANALYSIS = "LGBM_TPU_COST_ANALYSIS"
+
+# numeric report fields mirrored into the registry as cost.<site>.<field>
+_GAUGE_FIELDS = ("flops", "bytes_accessed", "transcendentals",
+                 "argument_bytes", "output_bytes", "temp_bytes",
+                 "generated_code_bytes", "peak_hbm_bytes")
+
+_lock = threading.Lock()
+_state: Dict = {"enabled": None}          # None = consult the env once
+_reports: Dict[str, Dict] = {}            # site -> normalized report
+# site -> (jitted callable, fingerprint) whose report is current. Holding
+# the callable itself (a STRONG reference) is load-bearing: an id()-keyed
+# set would let CPython reuse a garbage-collected step's address for a new
+# booster's step and silently skip its capture, leaving a stale report
+# under the site. A different callable — or the same shared callable with a
+# different caller-supplied fingerprint (predict's module-level walk serves
+# every forest) — re-captures; an unchanged pair never re-lowers.
+_captured: Dict[str, tuple] = {}
+
+
+# ------------------------------------------------------------- configuration
+
+def enabled() -> bool:
+    if _state["enabled"] is None:
+        _state["enabled"] = os.environ.get(ENV_COST_ANALYSIS, "").lower() \
+            not in ("", "0", "false", "off")
+    return bool(_state["enabled"])
+
+
+def configure(enabled: Optional[bool] = None) -> None:
+    """Force cost capture on/off (explicit calls beat the env knob)."""
+    if enabled is not None:
+        _state["enabled"] = bool(enabled)
+
+
+def reset_for_tests() -> None:
+    with _lock:
+        _state["enabled"] = None
+        _reports.clear()
+        _captured.clear()
+
+
+# ------------------------------------------------------------ normalization
+
+def _first_cost_dict(ca):
+    """``cost_analysis()`` returns a list of per-executable dicts on some
+    jax versions and a flat dict on others; normalize to one dict or None."""
+    if ca is None:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return ca if isinstance(ca, dict) else None
+
+
+def report_from_compiled(compiled, site: str, dims: Optional[Dict] = None
+                         ) -> Dict:
+    """Normalize one compiled executable's cost/memory analyses into the
+    report schema. Every field degrades to ``None`` when the backend
+    returns nothing (the graceful-fallback contract) — the report itself
+    always exists."""
+    out: Dict = {"site": site}
+    if dims:
+        out.update(dims)
+    out.update({"flops": None, "bytes_accessed": None,
+                "transcendentals": None})
+    try:
+        ca = _first_cost_dict(compiled.cost_analysis())
+    except Exception:                                        # noqa: BLE001
+        ca = None
+    if ca:
+        for field, key in (("flops", "flops"),
+                           ("bytes_accessed", "bytes accessed"),
+                           ("transcendentals", "transcendentals")):
+            v = ca.get(key)
+            if v is not None:
+                out[field] = float(v)
+    out.update({"argument_bytes": None, "output_bytes": None,
+                "temp_bytes": None, "generated_code_bytes": None,
+                "peak_hbm_bytes": None})
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:                                        # noqa: BLE001
+        ma = None
+    if ma is not None:
+        for field, attr in (("argument_bytes", "argument_size_in_bytes"),
+                            ("output_bytes", "output_size_in_bytes"),
+                            ("temp_bytes", "temp_size_in_bytes"),
+                            ("generated_code_bytes",
+                             "generated_code_size_in_bytes")):
+            v = getattr(ma, attr, None)
+            if v is not None:
+                out[field] = int(v)
+        # XLA's peak device residency for one execution: arguments stay
+        # live, temps are the while-carry + intermediates, outputs are
+        # written before arguments die (donation aliases some of this —
+        # the estimate is the safe upper bound)
+        parts = [out[f] for f in ("argument_bytes", "output_bytes",
+                                  "temp_bytes", "generated_code_bytes")]
+        if any(p is not None for p in parts):
+            out["peak_hbm_bytes"] = int(sum(p or 0 for p in parts))
+    return out
+
+
+# ----------------------------------------------------------------- capture
+
+def publish(report: Dict) -> None:
+    """Record a report: the site table (-> ``snapshot()``/Perfetto
+    metadata), ``cost.<site>.*`` gauges, and one instant trace event."""
+    site = report["site"]
+    with _lock:
+        _reports[site] = dict(report)
+    from . import event, get_registry
+    reg = get_registry()
+    for field in _GAUGE_FIELDS:
+        v = report.get(field)
+        if v is not None:
+            reg.gauge(f"cost.{site}.{field}").set(v)
+    ev = {k: v for k, v in report.items() if v is not None and k != "site"}
+    event("cost_report", site=site, **ev)
+
+
+def capture_jit(site: str, fn, args: Tuple = (), kwargs: Optional[Dict] = None,
+                dims: Optional[Dict] = None,
+                fingerprint=None) -> Optional[Dict]:
+    """Capture the cost/memory report of ``fn`` (a jitted callable) for the
+    given dispatch arguments — once per (callable, fingerprint): a NEW
+    callable at a known site re-captures and replaces the report, and a
+    SHARED callable (one module-level jit serving many shapes, like the
+    predict walk) re-captures whenever the caller's ``fingerprint``
+    (hashable shape summary) changes. Compile-time only, never raising into
+    the caller. Returns the report (or the previously captured one),
+    ``None`` when capture is disabled."""
+    if not enabled():
+        return None
+    with _lock:
+        prev = _captured.get(site)
+        if prev is not None and prev[0] is fn and prev[1] == fingerprint:
+            return _reports.get(site)
+        _captured[site] = (fn, fingerprint)
+    try:
+        lowered = fn.lower(*args, **(kwargs or {}))
+        compiled = lowered.compile()
+        report = report_from_compiled(compiled, site, dims)
+    except Exception as e:                                   # noqa: BLE001
+        # capture must never take a training run down: record the failure
+        # as the site's report so the absence is visible, not silent
+        report = dict(dims or {}, site=site,
+                      error=f"{type(e).__name__}: {e}"[:300])
+    try:
+        publish(report)
+    except Exception:                                        # noqa: BLE001
+        pass
+    return report
+
+
+# ------------------------------------------------------------------ access
+
+def reports() -> Dict[str, Dict]:
+    """Copy of every captured report, keyed by site (sorted)."""
+    with _lock:
+        return {k: dict(v) for k, v in sorted(_reports.items())}
+
+
+def report(site: str) -> Optional[Dict]:
+    with _lock:
+        r = _reports.get(site)
+        return dict(r) if r else None
+
+
+# ------------------------------------------------------------- golden pins
+
+def drift(report: Dict, golden: Dict, fields=("flops", "bytes_accessed"),
+          rel_tol: float = 0.35) -> Dict[str, Dict]:
+    """Compare a report against golden values; returns the out-of-band
+    fields as ``{field: {value, golden, ratio}}`` (empty = within band).
+
+    The band is relative (default +/-35%): XLA version bumps move absolute
+    FLOP/byte counts a little, while the regressions this pin exists to
+    catch (an accidental extra full-N pass, a dtype widening, a lost
+    donation) move them 2x. A ``None`` value against a numeric golden IS
+    drift — losing the measurement entirely must not pass the pin."""
+    tol = float(golden.get("rel_tol", rel_tol))
+    out = {}
+    for f in fields:
+        g = golden.get(f)
+        if g is None:
+            continue
+        v = report.get(f)
+        if v is None:
+            out[f] = {"value": None, "golden": g, "ratio": None}
+            continue
+        ratio = float(v) / float(g) if g else float("inf")
+        if not (1.0 - tol) <= ratio <= (1.0 + tol):
+            out[f] = {"value": float(v), "golden": float(g),
+                      "ratio": round(ratio, 4)}
+    return out

@@ -22,21 +22,33 @@ import os
 from typing import Dict, List
 
 
+def atomic_write_json(path: str, doc, *, indent=None,
+                      sort_keys: bool = False,
+                      trailing_newline: bool = False) -> str:
+    """The one tmp+``os.replace`` atomic JSON write (pid-suffixed temp so
+    concurrent writers in one checkout never clobber each other's
+    in-flight file): a crash or race mid-write can never leave a truncated
+    'valid' artifact behind. Used by the trace/snapshot exporters here and
+    the perf ledger."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=indent, sort_keys=sort_keys)
+        if trailing_newline:
+            fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
 def write_chrome_trace(events: List[Dict], path: str,
                        metadata: Dict = None) -> str:
     """Write ``events`` (already in trace-event schema, tracer.py) as a
-    Perfetto-loadable JSON object. tmp+rename so a crash mid-write can
-    never leave a truncated 'valid' trace behind."""
+    Perfetto-loadable JSON object, atomically."""
     doc = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": dict(metadata or {}, producer="lightgbm_tpu"),
     }
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as fh:
-        json.dump(doc, fh)
-    os.replace(tmp, path)
-    return path
+    return atomic_write_json(path, doc)
 
 
 class JsonlWriter:

@@ -1,0 +1,322 @@
+"""Perf regression ledger: normalized BENCH/MULTICHIP history + compare.
+
+The repo's measured trajectory lives in checked-in ``BENCH_r<N>.json`` /
+``MULTICHIP_r<N>.json`` files whose schemas grew organically (round 1 is a
+raw harness wrapper with ``parsed: null``, round 2 a bare payload, round 5
+a full phase report). This module normalizes that history into ONE
+machine-readable ledger (``PERF_LEDGER.json``) and answers the question no
+PR could answer before: *did this change regress a number we already
+banked?*
+
+- ``build_ledger()`` — rebuild the ledger from the checked-in files; the
+  one-shot ``python -m lightgbm_tpu.observability.ledger --rebuild`` keeps
+  the committed ledger from ever drifting from history (``--check`` fails
+  when it has).
+- ``compare(candidate, entries)`` — flag regressions of a fresh bench
+  payload against best-known values: throughput (per platform/rows/kernel
+  comparability key), post-warm-up recompiles, headline host syncs, peak
+  HBM, and compiled cost-model drift (FLOPs / bytes accessed, when both
+  sides carry cost reports). ``bench.py --compare`` wraps this and exits
+  nonzero on any flag; ``make bench-diff`` wires it into ``make verify``.
+
+Deliberately dependency-free (stdlib + the jax-free sibling
+``costs.drift`` for the one shared band check) and deterministic (no
+timestamps): rebuilding from the same files yields byte-identical output,
+so the committed ledger is diffable and the ``--check`` mode is a plain
+equality.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+LEDGER_FILE = "PERF_LEDGER.json"
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+# relative tolerances for compare(): generous enough to absorb run-to-run
+# noise on a shared tunnel, tight enough that a real regression (the 2x
+# cost of an extra full-N pass; a 20%+ throughput loss) always trips
+DEFAULT_TOLERANCES = {
+    "throughput": 0.15,       # value may sit up to 15% below best-known
+    "hbm": 0.15,              # peak HBM may grow up to 15%
+    "cost": 0.35,             # flops/bytes drift band vs recorded reports
+}
+
+
+def _round_of(path: str) -> Optional[int]:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def payload_of(path: str) -> Optional[Dict]:
+    """Extract the result payload from a history file: either a bare bench
+    JSON or the driver wrapper holding it under ``parsed``."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc and "metric" not in doc:
+        return doc["parsed"] if isinstance(doc["parsed"], dict) else None
+    return doc
+
+
+# ------------------------------------------------------------- normalization
+
+def normalize_bench(payload: Optional[Dict], source: str,
+                    round_: Optional[int]) -> Dict:
+    """One BENCH payload -> the normalized ledger entry schema. Missing
+    fields stay ``None`` — old rounds simply carry less signal."""
+    e: Dict = {"source": source, "round": round_, "kind": "bench",
+               "value": None, "unit": None, "vs_baseline": None,
+               "platform": None, "rows": None, "kernel": None,
+               "tree_batch": None, "auc": None,
+               "recompiles_post_warmup": None, "host_syncs": None,
+               "steady_s_per_iter": None, "hbm_peak_gb": None,
+               "cost": None, "error": None}
+    if not payload:
+        e["error"] = "unparseable history file"
+        return e
+    for k in ("value", "unit", "vs_baseline", "platform", "rows", "kernel",
+              "tree_batch", "auc", "recompiles_post_warmup", "hbm_peak_gb",
+              "error"):
+        if payload.get(k) is not None:
+            e[k] = payload[k]
+    head = (payload.get("phase_timings") or {}).get("headline") or {}
+    if head.get("host_syncs") is not None:
+        e["host_syncs"] = head["host_syncs"]
+    if head.get("steady_s_per_iter") is not None:
+        e["steady_s_per_iter"] = head["steady_s_per_iter"]
+    cost = (payload.get("telemetry") or {}).get("cost_reports") \
+        or payload.get("cost_reports")
+    if cost:
+        # keep only the drift-comparable numerics per site
+        e["cost"] = {site: {f: r.get(f) for f in
+                            ("flops", "bytes_accessed", "peak_hbm_bytes")
+                            if r.get(f) is not None}
+                     for site, r in cost.items() if isinstance(r, dict)}
+    return e
+
+
+def normalize_multichip(payload: Optional[Dict], source: str,
+                        round_: Optional[int]) -> Dict:
+    e = {"source": source, "round": round_, "kind": "multichip",
+         "ok": None, "n_devices": None, "rc": None}
+    if payload:
+        for k in ("ok", "n_devices", "rc"):
+            if payload.get(k) is not None:
+                e[k] = payload[k]
+    return e
+
+
+def load_history(root: str) -> List[Dict]:
+    """Normalized entries from every checked-in BENCH/MULTICHIP file,
+    round order."""
+    entries: List[Dict] = []
+    for pat, norm in (("BENCH_r*.json", normalize_bench),
+                      ("MULTICHIP_r*.json", normalize_multichip)):
+        for path in sorted(glob.glob(os.path.join(root, pat))):
+            entries.append(norm(payload_of(path), os.path.basename(path),
+                                _round_of(path)))
+    entries.sort(key=lambda e: (e.get("round") or 0, e["source"]))
+    return entries
+
+
+# ------------------------------------------------------------------ ledger
+
+def _clean(e: Dict) -> bool:
+    """A bench entry with a real measurement (nonzero value, no error)."""
+    return (e.get("kind") == "bench" and not e.get("error")
+            and isinstance(e.get("value"), (int, float)) and e["value"] > 0)
+
+
+def comparability_key(e: Dict) -> str:
+    """Entries are only compared within the same platform, scale, and
+    kernel — a 2.1M-row quick pre-bank must never be judged against the
+    10.5M headline, a CPU fallback against a TPU number, or a deliberate
+    ``LGBM_TPU_BENCH_KERNEL`` A/B arm against a different kernel's best."""
+    return (f"platform={e.get('platform')}|rows={e.get('rows')}"
+            f"|kernel={e.get('kernel')}")
+
+
+def best_known(entries: List[Dict],
+               exclude_source: Optional[str] = None) -> Dict[str, Dict]:
+    """Best clean bench entry per comparability key (highest value; the
+    recompile/host-sync/HBM floors are the minima over clean entries of
+    the key, carried next to it)."""
+    best: Dict[str, Dict] = {}
+    for e in entries:
+        if not _clean(e) or e.get("source") == exclude_source:
+            continue
+        key = comparability_key(e)
+        cur = best.get(key)
+        if cur is None or e["value"] > cur["entry"]["value"]:
+            best[key] = {"entry": e}
+    for key, slot in best.items():
+        group = [e for e in entries if _clean(e)
+                 and e.get("source") != exclude_source
+                 and comparability_key(e) == key]
+        for field in ("recompiles_post_warmup", "host_syncs", "hbm_peak_gb"):
+            vals = [e[field] for e in group if e.get(field) is not None]
+            slot[f"min_{field}"] = min(vals) if vals else None
+    return best
+
+
+def build_ledger(root: str) -> Dict:
+    entries = load_history(root)
+    best = {k: {"source": v["entry"]["source"],
+                "round": v["entry"]["round"],
+                "value": v["entry"]["value"],
+                "kernel": v["entry"].get("kernel"),
+                "min_recompiles_post_warmup":
+                    v.get("min_recompiles_post_warmup"),
+                "min_host_syncs": v.get("min_host_syncs"),
+                "min_hbm_peak_gb": v.get("min_hbm_peak_gb")}
+            for k, v in sorted(best_known(entries).items())}
+    return {"version": 1,
+            "baseline_mrow_tree_per_s": 22.0,
+            "entries": entries,
+            "best": best}
+
+
+def write_ledger(root: str, out_path: Optional[str] = None,
+                 doc: Optional[Dict] = None) -> str:
+    from .export import atomic_write_json
+    out_path = out_path or os.path.join(root, LEDGER_FILE)
+    doc = doc if doc is not None else build_ledger(root)
+    return atomic_write_json(out_path, doc, indent=1, sort_keys=True,
+                             trailing_newline=True)
+
+
+def check_ledger(root: str, path: Optional[str] = None) -> bool:
+    """True iff the committed ledger matches a fresh rebuild (no drift)."""
+    path = path or os.path.join(root, LEDGER_FILE)
+    try:
+        with open(path) as fh:
+            committed = json.load(fh)
+    except (OSError, ValueError):
+        return False
+    return committed == build_ledger(root)
+
+
+# ----------------------------------------------------------------- compare
+
+def compare(candidate: Dict, entries: List[Dict],
+            exclude_source: Optional[str] = None,
+            tolerances: Optional[Dict[str, float]] = None
+            ) -> Tuple[List[str], List[str]]:
+    """Flag regressions of ``candidate`` (a bench payload or normalized
+    entry) against the history. Returns (problems, notes): any problem
+    means regression — ``bench.py --compare`` exits nonzero on it."""
+    tol = dict(DEFAULT_TOLERANCES, **(tolerances or {}))
+    problems: List[str] = []
+    notes: List[str] = []
+    c = candidate if candidate.get("kind") == "bench" else \
+        normalize_bench(candidate, candidate.get("source", "<candidate>"),
+                        candidate.get("round"))
+    if not _clean(c):
+        problems.append(
+            f"candidate has no clean measurement (value={c.get('value')!r}, "
+            f"error={c.get('error')!r})")
+        return problems, notes
+    best = best_known(entries, exclude_source=exclude_source)
+    key = comparability_key(c)
+    slot = best.get(key)
+    if slot is None:
+        notes.append(f"no comparable history for {key} — nothing to regress "
+                     f"against")
+    else:
+        b = slot["entry"]
+        floor = b["value"] * (1.0 - tol["throughput"])
+        if c["value"] < floor:
+            problems.append(
+                f"throughput regression: {c['value']} {c.get('unit') or ''} "
+                f"vs best-known {b['value']} ({b['source']}, kernel="
+                f"{b.get('kernel')}) — below the {tol['throughput']:.0%} "
+                f"band floor {floor:.3g}")
+        else:
+            notes.append(f"throughput ok: {c['value']} vs best {b['value']} "
+                         f"({b['source']})")
+        min_rec = slot.get("min_recompiles_post_warmup")
+        if (c.get("recompiles_post_warmup") or 0) > 0 and min_rec == 0:
+            problems.append(
+                f"recompile regression: {c['recompiles_post_warmup']} "
+                f"post-warm-up cache miss(es) where history has 0")
+        min_sync = slot.get("min_host_syncs")
+        if (min_sync is not None and c.get("host_syncs") is not None
+                and c["host_syncs"] > min_sync):
+            problems.append(
+                f"host-sync regression: headline host_syncs "
+                f"{c['host_syncs']} vs best-known {min_sync}")
+        min_hbm = slot.get("min_hbm_peak_gb")
+        if (min_hbm is not None and c.get("hbm_peak_gb") is not None
+                and c["hbm_peak_gb"] > min_hbm * (1.0 + tol["hbm"])):
+            problems.append(
+                f"peak-HBM regression: {c['hbm_peak_gb']} GB vs best-known "
+                f"{min_hbm} GB (+{tol['hbm']:.0%} band)")
+        problems.extend(_cost_drift(c, b, tol["cost"]))
+    return problems, notes
+
+
+def _cost_drift(cand: Dict, base: Dict, rel_tol: float) -> List[str]:
+    """Compiled cost-model drift between two entries' shared sites — the
+    band logic IS ``costs.drift`` (one implementation; the golden pin and
+    the ledger gate cannot disagree on semantics, including 'losing the
+    measurement against a recorded number is drift')."""
+    from . import costs as _costs
+    out: List[str] = []
+    cc, bc = cand.get("cost") or {}, base.get("cost") or {}
+    for site in sorted(set(cc) & set(bc)):
+        bad = _costs.drift(cc[site], bc[site],
+                           fields=("flops", "bytes_accessed"),
+                           rel_tol=rel_tol)
+        for field, info in sorted(bad.items()):
+            out.append(
+                f"cost drift: {site}.{field} {info['value']} vs recorded "
+                f"{info['golden']} ({base['source']}) — ratio "
+                f"{info['ratio']} outside +/-{rel_tol:.0%}")
+    return out
+
+
+# --------------------------------------------------------------------- CLI
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.observability.ledger",
+        description="Rebuild/inspect the perf regression ledger "
+                    f"({LEDGER_FILE}) from checked-in BENCH_*/MULTICHIP_* "
+                    "history")
+    ap.add_argument("--root", default=".",
+                    help="repo root holding the history files")
+    ap.add_argument("--rebuild", action="store_true",
+                    help=f"rewrite {LEDGER_FILE} from the history files")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when the committed ledger does not match a "
+                         "fresh rebuild (drift)")
+    args = ap.parse_args(argv)
+    root = os.path.abspath(args.root)
+    if args.rebuild:
+        doc = build_ledger(root)
+        path = write_ledger(root, doc=doc)
+        print(f"ledger: wrote {path} ({len(doc['entries'])} entries, "
+              f"{len(doc['best'])} best-known keys)")
+    if args.check:
+        if not check_ledger(root):
+            print(f"ledger: {LEDGER_FILE} does NOT match the checked-in "
+                  f"history — run --rebuild and commit the result")
+            return 1
+        print("ledger: up to date with history")
+    if not args.rebuild and not args.check:
+        print(json.dumps(build_ledger(root), indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

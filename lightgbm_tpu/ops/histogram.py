@@ -435,6 +435,45 @@ def build_histograms(
     return combine_channels(acc, hilo)                            # [S, F, B, 3]
 
 
+def histogram_cost_report(n_rows: int, num_features: int,
+                          num_bins_padded: int, num_slots: int,
+                          chunk_rows: int, hilo=True, dtype=None,
+                          site: str = None) -> dict:
+    """Compile-time cost probe of the streaming histogram kernel at one
+    shape class: lower+compile a standalone jitted ``build_histograms`` on
+    zero inputs (values never affect the HLO) and publish the normalized
+    FLOPs/bytes/HBM report through observability/costs.py. This is the
+    kernel's dispatch-site cost leg — in production the kernel is fused
+    into the train step, so its isolated cost is only observable here
+    (golden-pinned in tests/test_costs.py). Explicit call = intent: runs
+    regardless of the ``costs.enabled()`` gate."""
+    from ..observability import costs as obs_costs
+    dtype = jnp.uint8 if dtype is None else dtype
+    n_rows = ((n_rows + chunk_rows - 1) // chunk_rows) * chunk_rows
+    X = jnp.zeros((n_rows, num_features), dtype)
+    zf = jnp.zeros(n_rows, jnp.float32)
+    leaf_id = jnp.zeros(n_rows, jnp.int32)
+    slot_of_leaf = jnp.zeros(num_slots + 1, jnp.int32)
+
+    def run(X, g, h, inc, lid, sol):
+        return build_histograms(X, g, h, inc, lid, sol, num_slots=num_slots,
+                                num_bins_padded=num_bins_padded,
+                                chunk_rows=chunk_rows, hilo=hilo)
+
+    site = site or f"histogram.stream.s{num_slots}"
+    dims = dict(rows=int(n_rows), features=int(num_features),
+                bins=int(num_bins_padded), slots=int(num_slots),
+                chunk_rows=int(chunk_rows))
+    try:
+        compiled = jax.jit(run).lower(X, zf, zf, zf, leaf_id,
+                                      slot_of_leaf).compile()
+        rep = obs_costs.report_from_compiled(compiled, site, dims)
+    except Exception as e:                                   # noqa: BLE001
+        rep = dict(dims, site=site, error=f"{type(e).__name__}: {e}"[:300])
+    obs_costs.publish(rep)
+    return rep
+
+
 def root_sums(grad: jnp.ndarray, hess: jnp.ndarray, included: jnp.ndarray
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Total (sum_g, sum_h, count) over included rows — root LeafSplits init

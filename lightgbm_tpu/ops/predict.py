@@ -252,8 +252,24 @@ def forest_predict_raw(trees, X: np.ndarray, num_features: int,
     for lo in range(0, X.shape[0], chunk_rows):
         chunk = np.asarray(X[lo:lo + chunk_rows], np.float64)
         codes, is_nan, is_zero = forest.encode_rows(chunk)
+        args = (*dev, jnp.asarray(codes), jnp.asarray(is_nan),
+                jnp.asarray(is_zero))
+        if lo == 0:
+            # cost-report leg of the predict dispatch (observability/costs):
+            # compile-time capture of the first chunk's signature, once
+            from ..observability import costs as obs_costs
+            if obs_costs.enabled():
+                # _forest_walk is ONE module-level jit serving every forest:
+                # the fingerprint makes a different forest/batch shape
+                # re-capture instead of serving the first model's numbers
+                obs_costs.capture_jit(
+                    "predict.forest_walk", _forest_walk, args,
+                    dims=dict(rows=int(codes.shape[0]),
+                              trees=int(forest.num_trees)),
+                    fingerprint=(int(codes.shape[0]), codes.shape[1],
+                                 int(forest.num_trees),
+                                 int(forest.max_leaves)))
         # host boundary: predict RETURNS numpy — the sync is the contract
-        out[lo:lo + chunk_rows] = np.asarray(_forest_walk(  # tpu-lint: disable=R002
-            *dev, jnp.asarray(codes), jnp.asarray(is_nan),
-            jnp.asarray(is_zero)))
+        out[lo:lo + chunk_rows] = np.asarray(  # tpu-lint: disable=R002
+            _forest_walk(*args))
     return out

@@ -114,6 +114,14 @@ def find_block_splits(hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
     return reduce_features(pf, bm.offset, is_cat=bm.is_cat, cat_mask=mask)
 
 
+# serialized size of one slot's SplitCandidates leaves (the all-gather
+# argmax payload): gain/left_g/left_h/left_c f32 + feature/threshold i32 +
+# default_left/is_cat bool + the [B] bool cat_mask — the analog of the
+# reference's serialized SplitInfo (split_info.hpp Size())
+def _split_candidate_bytes(num_bins_padded: int) -> int:
+    return 4 * 4 + 2 * 4 + 2 + num_bins_padded
+
+
 def _gather_argmax(cand: SplitCandidates, axis_name: str) -> SplitCandidates:
     """Global best split across devices: all-gather candidates, argmax on
     gain (reference SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207 —
@@ -160,6 +168,13 @@ class SerialComm:
 
     def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
         return find_block_splits(hist, pg, ph, pc, bm, spec)
+
+    def collective_bytes(self, num_slots: int, num_bins_padded: int) -> dict:
+        """Per-wave collective payload estimate in bytes, by collective —
+        the MULTICHIP cost story (observability/costs.py publishes these as
+        ``comm.bytes_per_wave.*`` gauges at booster construction). Serial
+        runs no collectives."""
+        return {}
 
 
 def _block_slice(arr, axis_index, block: int):
@@ -213,6 +228,19 @@ class DataParallelComm:
         return _gather_argmax(find_block_splits(hist, pg, ph, pc, bm, spec),
                               self.axis)
 
+    def collective_bytes(self, num_slots: int, num_bins_padded: int) -> dict:
+        """Data-parallel pays the full-width histogram reduce-scatter every
+        wave (the reference's ReduceScatter of HistogramBinEntry,
+        data_parallel_tree_learner.cpp:148-163) plus the candidate
+        all-gather and one 3-scalar root psum per tree."""
+        return {
+            "psum_root_scalars": 3 * 4,
+            "psum_scatter_hist": (num_slots * self.num_features
+                                  * num_bins_padded * 3 * 4),
+            "allgather_splits": (self.num_devices * num_slots
+                                 * _split_candidate_bytes(num_bins_padded)),
+        }
+
 
 @dataclass(frozen=True)
 class FeatureParallelComm:
@@ -238,6 +266,14 @@ class FeatureParallelComm:
     reduced_hist_features = SerialComm.reduced_hist_features
     block_meta = DataParallelComm.block_meta
     find_splits = DataParallelComm.find_splits
+
+    def collective_bytes(self, num_slots: int, num_bins_padded: int) -> dict:
+        """Feature-parallel never moves histograms — rows are replicated,
+        so the only wave collective is the candidate all-gather."""
+        return {
+            "allgather_splits": (self.num_devices * num_slots
+                                 * _split_candidate_bytes(num_bins_padded)),
+        }
 
 
 @dataclass(frozen=True)
@@ -300,6 +336,14 @@ class FeatureParallelBundledComm:
     def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
         return _gather_argmax(find_block_splits(hist, pg, ph, pc, bm, spec),
                               self.axis)
+
+    def collective_bytes(self, num_slots: int, num_bins_padded: int) -> dict:
+        """Bundled feature-parallel: bundles are the partition unit but the
+        wave collective is still only the candidate all-gather."""
+        return {
+            "allgather_splits": (self.num_devices * num_slots
+                                 * _split_candidate_bytes(num_bins_padded)),
+        }
 
 
 @dataclass(frozen=True)
@@ -390,6 +434,23 @@ class VotingParallelComm:
         # map local candidate index -> global feature id
         feat = jnp.take_along_axis(sel, cand.feature[:, None], axis=1)[:, 0]
         return cand._replace(feature=feat.astype(jnp.int32))
+
+    def collective_bytes(self, num_slots: int, num_bins_padded: int) -> dict:
+        """PV-Tree's O(k/F) trade made explicit: votes + gain ranks are
+        [S, F] f32 psums, and only the ~2k winning features' histogram
+        columns reduce (CopyLocalHistogram,
+        voting_parallel_tree_learner.cpp:197) — compare psum_selected_hist
+        here against DataParallelComm's full psum_scatter_hist."""
+        F = self.num_features
+        k2 = min(2 * max(1, min(self.top_k, F)), F)
+        return {
+            "psum_root_scalars": 3 * 4,
+            "psum_votes": num_slots * F * 4,
+            "psum_gain_ranks": num_slots * F * 4,
+            "psum_selected_hist": num_slots * k2 * num_bins_padded * 3 * 4,
+            "allgather_splits": (self.num_devices * num_slots
+                                 * _split_candidate_bytes(num_bins_padded)),
+        }
 
 
 class ParallelContext:

@@ -64,6 +64,8 @@ VOLATILE_CONFIG_FIELDS = frozenset({
     "machines", "machine_list_file", "local_listen_port", "time_out",
     # profiling/telemetry (observability/: spans, exporters, profiler window)
     "tpu_time_tag", "tpu_profile_dir", "tpu_profile_iters", "telemetry_dir",
+    # cost/memory introspection (observability/costs.py, snapshot dumps)
+    "tpu_cost_analysis", "dump_snapshot",
 })
 
 

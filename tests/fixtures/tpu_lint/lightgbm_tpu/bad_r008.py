@@ -1,0 +1,17 @@
+"""Fixture: violates exactly R008 — ad-hoc wall-clock timing inside
+lightgbm_tpu/ outside observability/ (both the dotted and the
+from-import form)."""
+import time
+from time import perf_counter
+
+
+def timed_update(step):
+    t0 = time.time()                  # R008: ad-hoc timing
+    step()
+    return time.time() - t0           # R008
+
+
+def timed_dispatch(step):
+    t0 = perf_counter()               # R008: from-import form
+    step()
+    return perf_counter() - t0        # R008
