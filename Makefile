@@ -36,6 +36,15 @@ bench-diff:
 ledger:
 	python -m lightgbm_tpu.observability.ledger --rebuild
 
+# Measured multi-chip story (docs/TPU-Performance.md "Multi-chip"): the
+# 8-device parity suite + the weak/strong-scaling bench on SIMULATED CPU
+# devices (bench.py --multichip re-execs one child per device count with
+# --xla_force_host_platform_device_count). On real chips run
+# LGBM_TPU_MULTICHIP_PLATFORM=tpu python bench.py --multichip instead.
+multichip:
+	env JAX_PLATFORMS=cpu $(PYTEST) tests/test_multichip_parity.py tests/test_parallel.py
+	env LGBM_TPU_MULTICHIP_OUT=$(CURDIR)/MULTICHIP_latest.json python bench.py --multichip
+
 # Fault-injection suite (docs/Fault-Tolerance.md): KV delay/drop/corruption
 # through the chaos harness + all three nan_policy branches + kill-and-resume.
 # The pinned seed makes a failing run replayable bit-for-bit.
@@ -65,4 +74,5 @@ trace:
 	env LGBM_TPU_TELEMETRY_DIR=$(CURDIR)/.telemetry python bench.py --smoke
 	@echo "trace: $$(ls -1t .telemetry/trace_*.json | head -1)"
 
-.PHONY: lint verify check-fast check capi bench-cpu chaos trace bench-diff ledger
+.PHONY: lint verify check-fast check capi bench-cpu chaos trace bench-diff \
+        ledger multichip

@@ -1300,6 +1300,312 @@ def run_smoke():
     return 0 if (ok and resume_ok and cache_ok and tel_ok and cost_ok) else 1
 
 
+# --------------------------------------------------------------- multichip
+
+def _multichip_child_env(d, platform, cache_dir):
+    """Environment for one scaling-point child: on the CPU backend the
+    device count is SIMULATED by re-arming --xla_force_host_platform_
+    device_count (the same hermetic forcing the test harness and
+    dryrun_multichip use); on real chips the child sees all devices and the
+    params slice the mesh (num_machines). The persistent compile cache is
+    inherited so repeat runs skip the per-device-count step compiles."""
+    from lightgbm_tpu.utils.hermetic import force_device_count_flags
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["XLA_FLAGS"] = force_device_count_flags(
+            env.get("XLA_FLAGS", ""), d)
+        env["LGBM_TPU_BENCH_PLATFORM"] = "cpu"     # hermetic child backend
+    else:
+        # a real-chip child must not inherit a stale CPU forcing (an
+        # exported LGBM_TPU_BENCH_PLATFORM=cpu would silently measure the
+        # host CPU under a platform='tpu' label)
+        env.pop("LGBM_TPU_BENCH_PLATFORM", None)
+        env["XLA_FLAGS"] = force_device_count_flags(
+            env.get("XLA_FLAGS", ""), None)
+    if cache_dir:
+        env["LGBM_TPU_COMPILE_CACHE_DIR"] = cache_dir
+    return env
+
+
+def run_multichip_child(argv):
+    """`bench.py --multichip-child <json>`: ONE scaling point — train the
+    configured strategy over this process's device mesh, measure steady
+    throughput under a record-only RecompileGuard, and report analytic vs
+    measured (compiled-HLO) collective bytes. Prints one JSON line."""
+    cfg = json.loads(argv[argv.index("--multichip-child") + 1])
+    if _FORCE_CPU:
+        from lightgbm_tpu.utils.hermetic import force_cpu_backend
+        force_cpu_backend()
+    from lightgbm_tpu.utils.cache import maybe_enable_compile_cache
+    maybe_enable_compile_cache()
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import observability as obs
+    from lightgbm_tpu.observability import costs as obs_costs
+    obs_costs.configure(enabled=True)    # measured collectives ride the
+                                         # compile-time cost capture
+    d = int(cfg["devices"])
+    rows = int(cfg["rows"])
+    params = dict(
+        objective="binary", num_leaves=int(cfg.get("num_leaves", 31)),
+        max_bin=int(cfg.get("max_bin", 63)), learning_rate=0.1,
+        min_data_in_leaf=20, verbose=-1, metric="none",
+        tpu_hist_kernel="xla", tree_batch=int(cfg.get("tree_batch", 4)),
+        tree_learner=cfg.get("strategy", "data"),
+        device="cpu" if cfg.get("platform") == "cpu" else "tpu")
+    if cfg.get("platform") != "cpu":
+        # real chips: the child sees the full mesh; num_machines slices the
+        # first d local devices (parallel/comm.py make_parallel_context).
+        # d=1 must be tree_learner=serial — the slice condition is nm > 1,
+        # so a data-parallel "d=1" child would silently train on ALL chips
+        if d > 1:
+            params["num_machines"] = d
+        else:
+            params["tree_learner"] = "serial"
+    X, y = _higgs_like(rows, seed=3)
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params=params, train_set=ds)
+    g = bst._gbdt
+    if g.pctx.num_devices != d:
+        # fail LOUDLY: measuring fewer chips than requested would file the
+        # point under the wrong device count (and the wrong ledger key)
+        raise RuntimeError(
+            f"requested {d} device(s) but the mesh resolved to "
+            f"{g.pctx.num_devices} — host has too few chips?")
+    out = {"requested_devices": d, "rows": rows}
+    out.update(g.pctx.describe())
+    timings = {}
+    el, guard, iters = _timed_update_phase(
+        f"mc_{cfg.get('phase', 'point')}_d{d}", bst,
+        int(cfg.get("warmup", 2)), int(cfg.get("timed", 4)), timings,
+        tree_batch=g.tree_batch)
+    tp = rows * iters / el / 1e6
+    out["mrow_tree_per_s"] = _round_tp(tp)
+    out["per_chip_mrow_tree_per_s"] = _round_tp(
+        tp / max(g.pctx.num_devices, 1))
+    rep = guard.report()
+    out["recompiles_post_warmup"] = rep["post_warmup_cache_misses"]
+    out["host_syncs"] = rep["host_syncs"]
+    out["tree_batch"] = g.tree_batch
+    out["phase_timings"] = timings
+    # analytic per-wave estimates (comm.bytes_per_wave.* gauges, published
+    # at booster construction) next to the measured compiled-HLO truth
+    gauges = obs.snapshot()["gauges"]
+    out["analytic_bytes_per_wave"] = {
+        k.split("comm.bytes_per_wave.")[-1]: v
+        for k, v in gauges.items() if k.startswith("comm.bytes_per_wave.")}
+    cost_rep = obs_costs.report(f"train_step.k{g.tree_batch}") or {}
+    coll = cost_rep.get("collectives")
+    if coll:
+        out["measured_collectives"] = coll
+        out["measured_wire_bytes"] = obs_costs.collective_wire_bytes(
+            coll, g.pctx.num_devices)
+    print(json.dumps(out))
+    return 0
+
+
+# analytic collective names -> the HLO op kind they lower to, for the
+# measured-vs-analytic ratio (psum -> all-reduce, psum_scatter ->
+# reduce-scatter, the candidate sync -> all-gather)
+_ANALYTIC_OP_OF = {
+    "psum_root_scalars": "all-reduce", "psum_votes": "all-reduce",
+    "psum_gain_ranks": "all-reduce", "psum_selected_hist": "all-reduce",
+    "psum_scatter_hist": "reduce-scatter",
+    "allgather_splits": "all-gather",
+}
+
+
+def run_multichip(argv):
+    """`bench.py --multichip`: measured multi-chip training — weak- and
+    strong-scaling phases over a device-count ladder, one killable child
+    process per point (simulated devices via
+    --xla_force_host_platform_device_count on the CPU backend, real chips
+    otherwise), per-phase watchdogs like the main bench's. Emits ONE
+    MULTICHIP json line with Mrow-tree/s per chip, scaling efficiency,
+    measured (compiled-HLO) vs analytic collective bytes, and per-point
+    recompile/host-sync counts; LGBM_TPU_MULTICHIP_OUT also writes it to a
+    file. Knobs: LGBM_TPU_MULTICHIP_{PLATFORM,DEVICES,ROWS_PER_DEV,ROWS,
+    TIMED_ITERS,TIMEOUT,LEARNER}."""
+    budget = int(os.environ.get("LGBM_TPU_MULTICHIP_TIMEOUT", "2700"))
+    t0 = time.time()
+
+    def deadline():
+        return budget - (time.time() - t0) - 20
+
+    def on_alarm(signum, frame):
+        raise BenchTimeout(f"multichip bench exceeded {budget}s")
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(budget)
+
+    platform = os.environ.get("LGBM_TPU_MULTICHIP_PLATFORM", "cpu")
+    cpu = platform == "cpu"
+    dev_counts = sorted({int(x) for x in os.environ.get(
+        "LGBM_TPU_MULTICHIP_DEVICES", "1,2,4,8").split(",") if x.strip()})
+    rows_per_dev = int(os.environ.get(
+        "LGBM_TPU_MULTICHIP_ROWS_PER_DEV",
+        "16000" if cpu else "1312500"))       # tpu: 10.5M/8 per chip
+    strong_rows = int(os.environ.get(
+        "LGBM_TPU_MULTICHIP_ROWS", "64000" if cpu else "2100000"))
+    timed = int(os.environ.get("LGBM_TPU_MULTICHIP_TIMED_ITERS", "4"))
+    learner = os.environ.get("LGBM_TPU_MULTICHIP_LEARNER", "data")
+    max_d = max(dev_counts)
+    from lightgbm_tpu.utils.cache import repo_cache_dir
+    cache_dir = os.environ.get("LGBM_TPU_COMPILE_CACHE_DIR")
+    if cache_dir is None:
+        cache_dir = repo_cache_dir()
+
+    result = {
+        "metric": "multichip_scaling",
+        "unit": "Mrow-tree/s/chip",
+        "platform": platform,
+        "simulated": cpu,
+        "tree_learner": learner,
+        "n_devices": max_d,
+        "device_counts": dev_counts,
+        "rows_per_device": rows_per_dev,
+        "rows_strong": strong_rows,
+        "weak": [],
+        "strong": [],
+    }
+    children = {}                      # (phase, d) -> full child payload
+
+    def run_child(phase, d, rows, strategy=learner):
+        cfg = {"devices": d, "rows": rows, "strategy": strategy,
+               "platform": platform, "phase": phase, "timed": timed,
+               "warmup": 2}
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--multichip-child", json.dumps(cfg)]
+        timeout = int(max(60, min(deadline() - 30, 900)))
+        with _phase_watchdog(f"{phase}_d{d}", timeout + 30):
+            r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                               text=True,
+                               env=_multichip_child_env(d, platform,
+                                                        cache_dir))
+        if r.returncode != 0 or not r.stdout.strip():
+            raise RuntimeError(
+                f"child {phase} d={d} rc={r.returncode}: "
+                f"{(r.stderr or 'no output')[-300:]}")
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    # the whole measurement section degrades on a blown global budget: the
+    # ONE-JSON-line contract holds on every path (a BenchTimeout escaping
+    # here would kill the process with no MULTICHIP json at all)
+    result["strategy_points"] = {}
+    try:
+        for phase, rows_of in (("weak", lambda d: rows_per_dev * d),
+                               ("strong", lambda d: strong_rows)):
+            for d in dev_counts:
+                if deadline() < 90:
+                    result[phase].append({"d": d,
+                                          "error": "budget exhausted"})
+                    continue
+                try:
+                    child = run_child(phase, d, rows_of(d))
+                    children[(phase, d)] = child
+                    result[phase].append({
+                        "d": child["n_devices"], "rows": child["rows"],
+                        "strategy": child["strategy"],
+                        "mesh_axis": child["mesh_axis"],
+                        "mrow_tree_per_s": child["mrow_tree_per_s"],
+                        "per_chip": child["per_chip_mrow_tree_per_s"],
+                        "recompiles_post_warmup":
+                            child["recompiles_post_warmup"],
+                        "host_syncs": child["host_syncs"],
+                    })
+                except BenchTimeout:
+                    raise
+                except Exception as e:                       # noqa: BLE001
+                    traceback.print_exc(file=sys.stderr)
+                    result[phase].append({"d": d, "error": str(e)[:200]})
+        # one smoke point per remaining strategy at the full mesh (the
+        # parity suite trains them for correctness; this records their
+        # throughput)
+        for strat in ("feature", "voting"):
+            if strat == learner or deadline() < 120:
+                continue
+            try:
+                child = run_child("strategy", max_d, rows_per_dev * max_d,
+                                  strategy=strat)
+                result["strategy_points"][strat] = {
+                    "d": child["n_devices"],
+                    "mrow_tree_per_s": child["mrow_tree_per_s"],
+                    "per_chip": child["per_chip_mrow_tree_per_s"],
+                    "recompiles_post_warmup":
+                        child["recompiles_post_warmup"],
+                }
+            except BenchTimeout:
+                raise
+            except Exception as e:                           # noqa: BLE001
+                result["strategy_points"][strat] = {"error": str(e)[:200]}
+    except BenchTimeout as e:
+        result["error"] = str(e)[:200]
+
+    def _tp(phase, d):
+        for p in result[phase]:
+            if p.get("d") == d and "mrow_tree_per_s" in p:
+                return p["mrow_tree_per_s"]
+        return None
+
+    # headline device count = the largest MEASURED mesh (children fail
+    # loudly on a requested/actual mismatch, so requested == actual for
+    # every recorded point; a short-chip host simply tops out lower)
+    measured_d = [p["d"] for p in result["weak"] + result["strong"]
+                  if "mrow_tree_per_s" in p]
+    head_d = max(measured_d) if measured_d else max_d
+    result["n_devices"] = head_d
+    for phase, field in (("weak", "weak_efficiency"),
+                         ("strong", "strong_efficiency")):
+        t1, td = _tp(phase, 1), _tp(phase, head_d)
+        # scaling efficiency = tp(D) / (D * tp(1)) for both phases (weak
+        # total rows grow with D, so ideal throughput is D x the 1-chip
+        # run either way); per-point efficiencies ride in the series
+        if t1 and td:
+            result[field] = round(td / (head_d * t1), 3)
+            for p in result[phase]:
+                if p.get("mrow_tree_per_s"):
+                    p["efficiency"] = round(
+                        p["mrow_tree_per_s"] / (p["d"] * t1), 3)
+    head = children.get(("weak", head_d))
+    if head:
+        result["per_chip_mrow_tree_per_s"] = \
+            head["per_chip_mrow_tree_per_s"]
+        analytic = head.get("analytic_bytes_per_wave") or {}
+        measured = head.get("measured_wire_bytes") or {}
+        cb = {"analytic_per_wave": analytic,
+              "measured_hlo_output": head.get("measured_collectives"),
+              "measured_wire_per_step": measured}
+        # like-for-like ratio: analytic names grouped by the HLO op they
+        # lower to, judged against the wire-byte model — the satellite
+        # 'fix any estimate off by >2x' check reads this field
+        by_op = {}
+        for name, nbytes in analytic.items():
+            op = _ANALYTIC_OP_OF.get(name)
+            if op:
+                by_op[op] = by_op.get(op, 0) + nbytes
+        ratios = {}
+        for op, abytes in sorted(by_op.items()):
+            m = measured.get(op)
+            if m and abytes:
+                ratios[op] = round(m / abytes, 3)
+        cb["measured_over_analytic"] = ratios
+        result["collective_bytes"] = cb
+    signal.alarm(0)
+    multi_ok = [p for p in result["weak"] + result["strong"]
+                if p.get("d", 0) > 1 and "mrow_tree_per_s" in p]
+    result["ok"] = bool(multi_ok
+                        and result.get("per_chip_mrow_tree_per_s"))
+    result["elapsed_s"] = round(time.time() - t0, 1)
+    line = json.dumps(result)
+    out_path = os.environ.get("LGBM_TPU_MULTICHIP_OUT")
+    if out_path:
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(json.dumps(result, indent=1, sort_keys=True) + "\n")
+        os.replace(tmp, out_path)
+    print(line)
+    return 0 if result["ok"] else 1
+
+
 def run_compare(argv):
     """`bench.py --compare [result.json]`: flag perf regressions of a bench
     result against the checked-in history (observability/ledger.py).
@@ -1338,6 +1644,24 @@ def run_compare(argv):
            "rows": (payload or {}).get("rows"),
            "problems": problems, "notes": notes,
            "ok": not problems}
+    if explicit == []:
+        # default mode also judges the newest MEASURED multichip report
+        # (dry-run wrappers from rounds 1-5 carry no numbers and are
+        # skipped): per-chip throughput regressions fail make bench-diff
+        for p in reversed(sorted(
+                _glob.glob(os.path.join(repo, "MULTICHIP_r*.json")))):
+            pl = perf_ledger.payload_of(p)
+            if not pl or pl.get("metric") != "multichip_scaling":
+                continue
+            mp, mn = perf_ledger.compare(
+                pl, entries, exclude_source=os.path.basename(p))
+            out["multichip"] = {"candidate": os.path.basename(p),
+                                "value": pl.get("per_chip_mrow_tree_per_s"),
+                                "problems": mp, "notes": mn, "ok": not mp}
+            problems = problems + mp
+            break
+    out["problems"] = problems
+    out["ok"] = not problems
     print(json.dumps(out))
     return 0 if not problems else 2
 
@@ -1349,5 +1673,9 @@ if __name__ == "__main__":
         sys.exit(run_smoke())
     elif "--compare" in sys.argv:
         sys.exit(run_compare(sys.argv))
+    elif "--multichip-child" in sys.argv:
+        sys.exit(run_multichip_child(sys.argv))
+    elif "--multichip" in sys.argv:
+        sys.exit(run_multichip(sys.argv))
     else:
         main()

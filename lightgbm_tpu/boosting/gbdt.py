@@ -27,7 +27,6 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import observability as obs
 from ..observability import costs as obs_costs
@@ -89,8 +88,12 @@ class GBDT:
         K = self.num_models
 
         # ---- device mesh / parallel strategy (reference Network::Init,
-        #      application.cpp:167-178; tree_learner grid tree_learner.cpp:9) --
-        self.pctx = make_parallel_context(config)
+        #      application.cpp:167-178; tree_learner grid tree_learner.cpp:9).
+        #      The training matrix shape rides along so tree_learner=auto can
+        #      resolve the mesh axis (rows vs features) from the shape class
+        #      (parallel/comm.py choose_tree_learner). --
+        self.pctx = make_parallel_context(
+            config, shape=(train_set.num_data, train_set.num_features))
 
         # ---- pre-partitioned data (reference dataset_loader.cpp:159-221 +
         #      Metadata::CheckOrPartition): under is_pre_partition each
@@ -353,20 +356,45 @@ class GBDT:
 
         # device placement of the (possibly bundled) code matrix: rows padded
         # to Npad (equal per-process blocks under pre-partition, where only
-        # the LOCAL shard exists on this host), columns to the strategy pad
+        # the LOCAL shard exists on this host), columns to the strategy pad.
+        # Placement goes through the Dataset's residency cache
+        # (dataset.device_put_cached): the sharded code matrix and padding
+        # mask are immutable step CONSTANTS, so every booster built over the
+        # same mesh/padding reuses the same on-device buffers — the binned
+        # dataset lives on the mesh once, not once per booster.
         col_pad = (0, cols_pad - Xb.shape[1])
         if self._block_counts is not None:
             bp = Npad // len(self._block_counts)
             self.Xb = self._put_rows0_local(
                 np.pad(Xb, ((0, bp - Xb.shape[0]), col_pad)), Npad)
         else:
-            self.Xb = self._put(np.pad(Xb, ((0, Npad - N), col_pad)), "rows0")
+            bundle_sig = None
+            if bundle_plan is not None:
+                # the bundled matrix's content is a pure function of the
+                # plan — fingerprint its column maps, not the N*G codes
+                import zlib
+                bundle_sig = (
+                    int(bundle_plan.num_groups),
+                    int(bundle_plan.max_bundle_bins),
+                    zlib.crc32(np.ascontiguousarray(bundle_plan.col).tobytes()),
+                    zlib.crc32(np.ascontiguousarray(bundle_plan.off).tobytes()))
+            self.Xb = train_set.device_put_cached(
+                ("Xb", Npad, cols_pad, str(Xb.dtype), bundle_sig,
+                 self.pctx.residency_key()),
+                lambda: self._put(np.pad(Xb, ((0, Npad - N), col_pad)),
+                                  "rows0"))
         self.label = self._put(self._row_layout(meta_global.label, Npad), "rows")
         w = meta_global.weight
         self.weight = None if w is None else self._put(
             self._row_layout(w, Npad), "rows")
-        self.pad_mask = self._put(
-            self._row_layout(np.ones(N, np.float32), Npad), "rows")
+        if self._block_counts is None:
+            self.pad_mask = train_set.device_put_cached(
+                ("pad_mask", Npad, N, self.pctx.residency_key()),
+                lambda: self._put(self._row_layout(np.ones(N, np.float32),
+                                                   Npad), "rows"))
+        else:
+            self.pad_mask = self._put(
+                self._row_layout(np.ones(N, np.float32), Npad), "rows")
 
         fpad = F_pad - F
         self.num_bins = self._put(np.pad(meta["num_bins"], (0, fpad), constant_values=1))
@@ -561,12 +589,23 @@ class GBDT:
         reg.gauge("booster.hist_slots").set(self.spec.hist_slots)
         obs.event("booster_init", kernel=hist_kernel, tree_batch=tb,
                   rows=int(N), features=int(F), num_leaves=int(num_leaves),
-                  strategy=self.pctx.strategy, nan_policy=self.nan_policy)
-        # MULTICHIP story: analytic per-wave collective payload estimates
-        # (parallel/comm.py collective_bytes) — host arithmetic at
-        # construction, so the comm budget is inspectable before any
-        # distributed dispatch runs
-        comm_bytes = self.comm.collective_bytes(self.spec.hist_slots, Bpad)
+                  strategy=self.pctx.strategy, nan_policy=self.nan_policy,
+                  mesh_axis=self.pctx.axis_kind,
+                  n_devices=self.pctx.num_devices)
+        # MULTICHIP story: the resolved mesh (device count + which dataset
+        # axis it shards — the tree_learner=auto outcome) and the analytic
+        # per-wave collective payload estimates (parallel/comm.py
+        # collective_bytes) — host arithmetic at construction, so the comm
+        # budget is inspectable before any distributed dispatch runs
+        reg.gauge("comm.mesh.n_devices").set(self.pctx.num_devices)
+        reg.gauge("comm.mesh.rows_sharded").set(
+            1 if self.pctx.axis_kind == "rows" else 0)
+        reg.counter(f"booster.tree_learner.{self.pctx.strategy}").inc()
+        if self.pctx.mesh is not None:
+            obs.event("mesh_axes", **self.pctx.describe())
+        comm_bytes = self.comm.collective_bytes(
+            self.spec.hist_slots, Bpad,
+            use_categorical=self.spec.use_categorical)
         for cname, nbytes in comm_bytes.items():
             reg.gauge(f"comm.bytes_per_wave.{cname}").set(nbytes)
         if comm_bytes:
@@ -611,29 +650,23 @@ class GBDT:
         process's padded block — no process ever holds the others' features
         (jax.make_array_from_process_local_data; the reference's
         pre-partitioned load keeps shards local the same way)."""
-        pctx = self.pctx
-        sharding = NamedSharding(pctx.mesh, P(pctx.ROW_AXIS, None))
+        sharding = self.pctx.sharding("rows0")
         return jax.make_array_from_process_local_data(
             sharding, local_block, (npad, local_block.shape[1]))
 
     def _put(self, x, kind: str = "repl"):
-        """Place an array on this booster's device(s).
-
-        kind: "rows" ([N] sharded), "rows0" ([N, F] rows on dim 0),
-        "rows1" ([K, N] rows on dim 1), "repl" (replicated). Row sharding only
-        applies to row-partitioned strategies (data/voting); the feature
-        strategy replicates rows like the reference's FeatureParallel learner
-        (every machine holds all data, feature_parallel_tree_learner.cpp).
-        """
+        """Place an array on this booster's device(s) with the mesh-resident
+        NamedSharding the strategy's axis role dictates
+        (``ParallelContext.sharding``): "rows" ([N] sharded), "rows0"
+        ([N, F] rows on dim 0), "rows1" ([K, N] rows on dim 1), "repl"
+        (replicated). Row sharding only applies to row-partitioned
+        strategies (data/voting); the feature strategy replicates rows like
+        the reference's FeatureParallel learner (every machine holds all
+        data, feature_parallel_tree_learner.cpp)."""
         pctx = self.pctx
-        if pctx.mesh is None:
+        sharding = pctx.sharding(kind)
+        if sharding is None:
             return jax.device_put(jnp.asarray(x), pctx.devices[0])
-        if kind == "repl" or pctx.strategy == "feature":
-            spec = P()
-        else:
-            spec = {"rows": P(pctx.ROW_AXIS), "rows0": P(pctx.ROW_AXIS, None),
-                    "rows1": P(None, pctx.ROW_AXIS)}[kind]
-        sharding = NamedSharding(pctx.mesh, spec)
         if pctx.multi_process:
             # every process holds the full (host) array; materialize only the
             # locally-addressable shards of the global sharded array — the
@@ -894,7 +927,10 @@ class GBDT:
                       hist_slots=int(self.spec.hist_slots),
                       tree_batch=int(batch), num_models=int(self.num_models),
                       kernel=self.spec.hist_kernel,
-                      strategy=self.pctx.strategy))
+                      strategy=self.pctx.strategy,
+                      # gates the measured-collectives HLO scan (costs.py):
+                      # serial steps never materialize the HLO text
+                      n_devices=int(self.pctx.num_devices)))
 
     def _run_step(self, score, shrinkage: float, custom_gh=None):
         """Dispatch one compiled step against current state; returns new score
@@ -1392,6 +1428,13 @@ class GBDT:
             "num_data": int(self.num_data),
             "num_data_padded": int(self.num_data_padded),
             "num_models": int(self.num_models),
+            # mesh provenance: restore rejects a device-count change loudly
+            # (or re-shards deliberately under tpu_reshard_on_resume) —
+            # sharded state must never produce a silent shape error
+            "n_devices": int(self.pctx.num_devices),
+            "tree_learner": self.pctx.strategy,
+            "block_layout": (None if self._block_counts is None
+                             else list(self._block_counts)),
             "init_score_value": float(self.init_score_value),
             "score": np.asarray(self._fetch(self.score), np.float32),
             "bag_mask": np.asarray(self._fetch(self.bag_mask), np.float32),
@@ -1409,10 +1452,44 @@ class GBDT:
         with the same sharding kinds construction used, so an
         already-compiled step keeps hitting its executable — resume costs
         the normal first-step compile and nothing more (RecompileGuard-
-        verified in ``bench.py --smoke``)."""
-        for name, mine in (("num_data", self.num_data),
-                           ("num_data_padded", self.num_data_padded),
-                           ("num_models", self.num_models)):
+        verified in ``bench.py --smoke``).
+
+        Device-count changes are checked FIRST: a snapshot written on a
+        different mesh is rejected loudly (the padded row layout, and under
+        pre-partition the block layout, are functions of the device count —
+        letting it through would surface as an opaque shape error). Setting
+        ``tpu_reshard_on_resume=true`` re-shards deliberately instead: the
+        training state is global-semantics (scores/masks in global row
+        order, trees replicated), so the padded rows are re-laid-out onto
+        this booster's mesh. Pre-partitioned snapshots never re-shard."""
+        saved_d = state.get("n_devices")
+        reshard = (saved_d is not None
+                   and int(saved_d) != int(self.pctx.num_devices))
+        if reshard:
+            if not getattr(self.config, "tpu_reshard_on_resume", False):
+                Log.fatal(
+                    "checkpoint/mesh mismatch: the snapshot was written on "
+                    "%d device(s) (tree_learner=%s) but this booster runs "
+                    "on %d (%s) — sharded training state does not resume "
+                    "across device counts. Rerun on the original mesh, or "
+                    "set tpu_reshard_on_resume=true to re-shard the global "
+                    "state deliberately", int(saved_d),
+                    state.get("tree_learner", "?"), self.pctx.num_devices,
+                    self.pctx.strategy)
+            if state.get("block_layout") or self._block_counts is not None:
+                Log.fatal(
+                    "tpu_reshard_on_resume: pre-partitioned snapshots hold "
+                    "per-process row blocks and cannot re-shard — resume on "
+                    "the original process count")
+            Log.warning("tpu_reshard_on_resume: re-sharding checkpoint "
+                        "state written on %d device(s) onto %d (%s)",
+                        int(saved_d), self.pctx.num_devices,
+                        self.pctx.strategy)
+        shape_checks = [("num_data", self.num_data),
+                        ("num_models", self.num_models)]
+        if not reshard:
+            shape_checks.append(("num_data_padded", self.num_data_padded))
+        for name, mine in shape_checks:
             if int(state[name]) != int(mine):
                 Log.fatal("checkpoint/booster mismatch: %s is %d in the "
                           "snapshot but %d here — resume needs the same "
@@ -1425,10 +1502,23 @@ class GBDT:
                       "fingerprint differs) — a shape-compatible but "
                       "different dataset would silently corrupt the resumed "
                       "model")
-        self.score = self._put(np.asarray(state["score"], np.float32),
-                               "rows1")
-        self.bag_mask = self._put(np.asarray(state["bag_mask"], np.float32),
-                                  "rows")
+
+        def _relayout(arr):
+            # deliberate re-shard: the saved padded layout ([..., Npad_old],
+            # real rows at the head — block layouts were rejected above) is
+            # re-laid-out onto this booster's padding. Padding positions
+            # carry no training signal (gradients are pad-masked; scores of
+            # padding rows never reach metrics), so a zero refill is exact.
+            arr = np.asarray(arr, np.float32)
+            if not reshard or arr.shape[-1] == self.num_data_padded:
+                return arr
+            real = arr[..., : self.num_data]
+            if real.ndim == 1:
+                return self._row_layout(real)
+            return np.stack([self._row_layout(r) for r in real])
+
+        self.score = self._put(_relayout(state["score"]), "rows1")
+        self.bag_mask = self._put(_relayout(state["bag_mask"]), "rows")
         self._rng_key = self._put(np.asarray(state["rng_key"]))
         self.models = [[jax.tree.map(self._put, t) for t in it_trees]
                        for it_trees in state["models"]]

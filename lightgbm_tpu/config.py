@@ -262,7 +262,13 @@ class Config:
     top_rate: float = 0.2
     other_rate: float = 0.1
     boost_from_average: bool = True
-    tree_learner: str = "serial"              # serial | feature | data | voting
+    # serial | feature | data | voting, plus the TPU addition "auto":
+    # resolve the strategy (and hence which dataset dimension the device
+    # mesh shards — rows vs features) from the training matrix's shape
+    # class per the reference's Parallel-Learning-Guide table
+    # (parallel/comm.py choose_tree_learner); tpu_mesh_axis overrides the
+    # axis side of that choice
+    tree_learner: str = "serial"
 
     # --- network (config.h:264-272) — mapped onto jax.distributed -----------
     num_machines: int = 1
@@ -272,6 +278,17 @@ class Config:
     machines: str = ""
 
     # --- TPU-specific knobs (no reference equivalent) -----------------------
+    # mesh-axis override for tree_learner=auto: "rows" constrains the
+    # resolution to the row-sharded strategies (data/voting), "features"
+    # forces feature-parallel, "auto" lets the shape class decide. Ignored
+    # (with a warning when inconsistent) when tree_learner is explicit.
+    tpu_mesh_axis: str = "auto"
+    # resume a checkpoint written on a DIFFERENT device count: off (the
+    # default) rejects loudly at restore time — sharded state does not
+    # silently re-layout; true re-shards the global training state onto
+    # this booster's mesh deliberately (single-process only; pre-partitioned
+    # snapshots never re-shard). See docs/Fault-Tolerance.md.
+    tpu_reshard_on_resume: bool = False
     # leaf splits applied per device-side wave; 0 = auto (frontier-wide,
     # leaf-wise order preserved near the leaf budget), 1 = exact LightGBM
     # one-leaf-at-a-time growth.
@@ -410,8 +427,20 @@ class Config:
             Log.fatal("bagging_fraction must be in (0, 1], got %g", self.bagging_fraction)
         if self.boosting_type not in ("gbdt", "gbrt", "dart", "goss", "rf", "random_forest"):
             Log.fatal("Unknown boosting type %s", self.boosting_type)
-        if self.tree_learner not in ("serial", "feature", "data", "voting"):
+        if self.tree_learner not in ("serial", "feature", "data", "voting",
+                                     "auto"):
             Log.fatal("Unknown tree learner type %s", self.tree_learner)
+        if self.tpu_mesh_axis not in ("auto", "rows", "features"):
+            Log.fatal("Unknown tpu_mesh_axis %s (auto|rows|features)",
+                      self.tpu_mesh_axis)
+        if self.tpu_mesh_axis != "auto" and self.tree_learner not in \
+                ("auto", "serial"):
+            expected = "features" if self.tree_learner == "feature" else "rows"
+            if self.tpu_mesh_axis != expected:
+                Log.warning("tpu_mesh_axis=%s is ignored: tree_learner=%s "
+                            "shards the %s axis by definition (the knob only "
+                            "constrains tree_learner=auto)",
+                            self.tpu_mesh_axis, self.tree_learner, expected)
         if self.tpu_hist_kernel not in ("auto", "xla", "pallas", "mixed"):
             Log.fatal("Unknown tpu_hist_kernel %s (auto|xla|pallas|mixed)",
                       self.tpu_hist_kernel)

@@ -136,6 +136,12 @@ class ConstructedDataset(MetadataDuckTyping):
         counts = np.array([m.num_bin for m in self.mappers], dtype=np.int64)
         self.bin_offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
         self.num_bins_per_feature = counts.astype(np.int32)
+        # sharded device residency (boosting/gbdt.py): the padded binned
+        # code matrix placed on the booster's mesh, cached per placement
+        # key so the dataset's device residency is first-class — every
+        # booster built over the same mesh/padding reuses the SAME device
+        # buffers instead of re-uploading N*F bytes per construction
+        self._device_cache: Dict[tuple, object] = {}
 
     # -- shape ----------------------------------------------------------------
 
@@ -173,6 +179,36 @@ class ConstructedDataset(MetadataDuckTyping):
             "num_bins": self.num_bins_per_feature,
             "bin_offsets": self.bin_offsets,
         }
+
+    # -- sharded device residency (docs/TPU-Performance.md, multichip) --------
+
+    def device_put_cached(self, key: tuple, build):
+        """Device residency cache for this dataset's immutable training
+        arrays (the binned code matrix and the padding mask).
+
+        ``key`` must capture everything that determines the placed array —
+        the ParallelContext residency key (mesh devices + strategy axis),
+        padded shape, dtype, and the EFB bundle signature — and ``build()``
+        materializes it (host pad + ``device_put``/``NamedSharding``). The
+        first booster pays the host->device transfer; every later booster
+        over the same mesh gets the SAME on-device buffers (safe because
+        these arrays travel as non-donated step constants,
+        boosting/gbdt.py ``_STEP_CONSTS``). Mutable metadata (labels,
+        weights) is deliberately NOT cached — ``set_label`` after
+        construction must keep working.
+
+        One entry per logical name (``key[0]``): switching the same Dataset
+        to a different mesh/strategy/padding evicts the previous placement
+        rather than pinning a second full device copy for the Dataset's
+        lifetime (live boosters keep their own references; only the cache
+        slot is bounded)."""
+        arr = self._device_cache.get(key)
+        if arr is None:
+            for stale in [k for k in self._device_cache if k[0] == key[0]]:
+                del self._device_cache[stale]
+            arr = build()
+            self._device_cache[key] = arr
+        return arr
 
     # -- alignment (valid sets share the train mappers) -----------------------
 

@@ -108,6 +108,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
         Log.debug("HBM pre-flight estimate failed: %s: %s",
                   type(e).__name__, e)
 
+    # resolved mesh (multichip): which axis the device mesh shards — the
+    # tree_learner=auto outcome — and the per-device row residency, logged
+    # once so a scaling run's provenance is in the training log
+    _pctx = booster._gbdt.pctx
+    if _pctx.mesh is not None:
+        _rows_dev = (booster._gbdt.num_data_padded // _pctx.num_devices
+                     if _pctx.axis_kind == "rows"
+                     else booster._gbdt.num_data_padded)
+        Log.info("multichip: %d-device mesh, tree_learner=%s shards the "
+                 "%s axis (~%d resident rows/device)", _pctx.num_devices,
+                 _pctx.strategy, _pctx.axis_kind, _rows_dev)
+
     # continued training: seed scores with the loaded model's raw predictions
     # (reference: input_model re-prediction, application.cpp:90-93) and keep
     # its trees so the saved model contains the full forest

@@ -41,6 +41,7 @@ environments (the lint CLI path) like the rest of the subsystem.
 from __future__ import annotations
 
 import os
+import re
 import threading
 from typing import Dict, Optional, Tuple
 
@@ -144,6 +145,98 @@ def report_from_compiled(compiled, site: str, dims: Optional[Dict] = None
                                   "temp_bytes", "generated_code_bytes")]
         if any(p is not None for p in parts):
             out["peak_hbm_bytes"] = int(sum(p or 0 for p in parts))
+    # measured collectives: scan the optimized HLO once (compile-time
+    # only) — gated on the CALLER's mesh size (``dims["n_devices"]``, the
+    # booster's own device count): a single-device program cannot contain
+    # collectives, and materializing the full HLO text of a bench-scale
+    # fused step just to parse an empty dict is real memory — a serial
+    # booster on a multi-device host must not pay it either
+    if (dims or {}).get("n_devices", 0) > 1:
+        try:
+            text = compiled.as_text()
+        except Exception:                                    # noqa: BLE001
+            text = None
+        if text:
+            coll = hlo_collectives(text)
+            if coll:
+                out["collectives"] = coll
+    return out
+
+
+# --------------------------------------------------- measured collectives
+
+# one optimized-HLO instruction: `%name = <shape> <op>(...)` where <op> is
+# a cross-device collective. Async pairs lower as `-start`/`-done`; only the
+# `-start` (or the sync form) carries the transfer, so `-done` is excluded
+# (after the op name only `-start(` or `(` may follow). The tuple branch is
+# GREEDY (`\(.*\)`): TPU layouts carry parens inside the shape —
+# `(f32[1024]{0:T(1024)}, ...)` — so a lazy/negated match would stop at the
+# first `)` and silently drop every async TPU collective.
+_HLO_COLLECTIVE_RE = re.compile(
+    r"=\s*(?P<shape>\(.*\)|\S+)\s+"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?P<start>-start)?\(")
+_HLO_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_HLO_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                    "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f32": 4,
+                    "s64": 8, "u64": 8, "f64": 8}
+
+
+def hlo_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """MEASURED cross-device traffic of a compiled executable: scan the
+    optimized HLO for collective instructions and sum their output-shape
+    bytes per op kind -> ``{op: {"instances": n, "output_bytes": b}}``.
+
+    This is the ground truth the analytic ``parallel/comm.py
+    collective_bytes`` estimates are validated against (``bench.py
+    --multichip`` reports both and their ratio): an in-loop collective
+    appears once in the HLO and executes once per wave, exactly the
+    per-wave unit the analytic estimates use."""
+    out: Dict[str, Dict[str, int]] = {}
+    for m in _HLO_COLLECTIVE_RE.finditer(hlo_text):
+        shapes = _HLO_SHAPE_RE.findall(m.group("shape"))
+        if m.group("start") and m.group("shape").startswith("("):
+            # async form: the tuple is (aliased operands..., results...,
+            # context scalars...) — counting everything would double-count
+            # the transfer (2x for all-reduce-start, (D+1)/D for
+            # all-gather-start). Drop collective-permute's u32[] context
+            # scalars first, then keep the result half only.
+            shapes = [s for s in shapes
+                      if not (s[1] == "" and s[0] in ("u32", "s32"))]
+            shapes = shapes[len(shapes) // 2:]
+        nbytes = 0
+        for dtype, dims in shapes:
+            size = _HLO_DTYPE_BYTES.get(dtype)
+            if size is None:          # token/opaque tuple elements
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes += n * size
+        slot = out.setdefault(m.group("op"),
+                              {"instances": 0, "output_bytes": 0})
+        slot["instances"] += 1
+        slot["output_bytes"] += nbytes
+    return out
+
+
+def collective_wire_bytes(collectives: Dict[str, Dict[str, int]],
+                          n_devices: int) -> Dict[str, float]:
+    """Per-op-kind bytes actually moved over the interconnect per device,
+    from the HLO output shapes under the standard ring-collective cost
+    model: all-reduce ~ 2(D-1)/D x payload, all-gather ~ (D-1)/D x gathered
+    output, reduce-scatter ~ (D-1) x scattered output (the output is 1/D of
+    the reduced payload), permute/all-to-all ~ the moved shape itself."""
+    D = max(int(n_devices), 1)
+    factor = {"all-reduce": 2.0 * (D - 1) / D,
+              "all-gather": (D - 1) / D,
+              "reduce-scatter": float(D - 1),
+              "collective-permute": 1.0,
+              "all-to-all": 1.0}
+    out = {op: round(rec["output_bytes"] * factor.get(op, 1.0), 1)
+           for op, rec in collectives.items()}
+    out["total"] = round(sum(out.values()), 1)
     return out
 
 

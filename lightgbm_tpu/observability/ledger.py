@@ -75,7 +75,7 @@ def normalize_bench(payload: Optional[Dict], source: str,
     e: Dict = {"source": source, "round": round_, "kind": "bench",
                "value": None, "unit": None, "vs_baseline": None,
                "platform": None, "rows": None, "kernel": None,
-               "tree_batch": None, "auc": None,
+               "n_devices": None, "tree_batch": None, "auc": None,
                "recompiles_post_warmup": None, "host_syncs": None,
                "steady_s_per_iter": None, "hbm_peak_gb": None,
                "cost": None, "error": None}
@@ -83,8 +83,8 @@ def normalize_bench(payload: Optional[Dict], source: str,
         e["error"] = "unparseable history file"
         return e
     for k in ("value", "unit", "vs_baseline", "platform", "rows", "kernel",
-              "tree_batch", "auc", "recompiles_post_warmup", "hbm_peak_gb",
-              "error"):
+              "n_devices", "tree_batch", "auc", "recompiles_post_warmup",
+              "hbm_peak_gb", "error"):
         if payload.get(k) is not None:
             e[k] = payload[k]
     head = (payload.get("phase_timings") or {}).get("headline") or {}
@@ -105,12 +105,30 @@ def normalize_bench(payload: Optional[Dict], source: str,
 
 def normalize_multichip(payload: Optional[Dict], source: str,
                         round_: Optional[int]) -> Dict:
+    """Two generations of MULTICHIP files: rounds 1-5 are dry-run wrappers
+    (``{n_devices, rc, ok, tail}`` — a train step compiled, nothing
+    measured), round 6+ are ``bench.py --multichip`` scaling reports whose
+    headline is Mrow-tree/s PER CHIP at the max device count plus weak/
+    strong scaling efficiency. Both normalize here; only measured entries
+    carry a ``value`` and participate in the regression gate."""
     e = {"source": source, "round": round_, "kind": "multichip",
-         "ok": None, "n_devices": None, "rc": None}
+         "ok": None, "n_devices": None, "rc": None,
+         "value": None, "unit": None, "platform": None,
+         "rows_per_device": None, "tree_learner": None,
+         "weak_efficiency": None, "strong_efficiency": None,
+         "simulated": None, "error": None}
     if payload:
         for k in ("ok", "n_devices", "rc"):
             if payload.get(k) is not None:
                 e[k] = payload[k]
+        if payload.get("metric") == "multichip_scaling":
+            e["value"] = payload.get("per_chip_mrow_tree_per_s")
+            e["unit"] = "Mrow-tree/s/chip"
+            for k in ("platform", "rows_per_device", "tree_learner",
+                      "weak_efficiency", "strong_efficiency", "simulated",
+                      "error"):
+                if payload.get(k) is not None:
+                    e[k] = payload[k]
     return e
 
 
@@ -136,12 +154,45 @@ def _clean(e: Dict) -> bool:
 
 
 def comparability_key(e: Dict) -> str:
-    """Entries are only compared within the same platform, scale, and
-    kernel — a 2.1M-row quick pre-bank must never be judged against the
-    10.5M headline, a CPU fallback against a TPU number, or a deliberate
-    ``LGBM_TPU_BENCH_KERNEL`` A/B arm against a different kernel's best."""
+    """Entries are only compared within the same platform, scale, kernel,
+    and device count — a 2.1M-row quick pre-bank must never be judged
+    against the 10.5M headline, a CPU fallback against a TPU number, a
+    deliberate ``LGBM_TPU_BENCH_KERNEL`` A/B arm against a different
+    kernel's best, or a single-chip headline against an 8-chip mesh run
+    (``n_devices`` is None on the pre-multichip history — those entries
+    keep comparing among themselves)."""
     return (f"platform={e.get('platform')}|rows={e.get('rows')}"
-            f"|kernel={e.get('kernel')}")
+            f"|kernel={e.get('kernel')}|n_devices={e.get('n_devices')}")
+
+
+def multichip_key(e: Dict) -> str:
+    """Comparability key for measured multichip entries: per-chip numbers
+    only compare at the same platform, per-device scale, device count, and
+    strategy."""
+    return (f"multichip|platform={e.get('platform')}"
+            f"|rows_per_device={e.get('rows_per_device')}"
+            f"|n_devices={e.get('n_devices')}"
+            f"|learner={e.get('tree_learner')}")
+
+
+def _clean_multichip(e: Dict) -> bool:
+    return (e.get("kind") == "multichip" and not e.get("error")
+            and isinstance(e.get("value"), (int, float)) and e["value"] > 0)
+
+
+def best_known_multichip(entries: List[Dict],
+                         exclude_source: Optional[str] = None
+                         ) -> Dict[str, Dict]:
+    """Best measured multichip entry per key (highest per-chip value)."""
+    best: Dict[str, Dict] = {}
+    for e in entries:
+        if not _clean_multichip(e) or e.get("source") == exclude_source:
+            continue
+        key = multichip_key(e)
+        cur = best.get(key)
+        if cur is None or e["value"] > cur["value"]:
+            best[key] = e
+    return best
 
 
 def best_known(entries: List[Dict],
@@ -178,10 +229,16 @@ def build_ledger(root: str) -> Dict:
                 "min_host_syncs": v.get("min_host_syncs"),
                 "min_hbm_peak_gb": v.get("min_hbm_peak_gb")}
             for k, v in sorted(best_known(entries).items())}
+    best_mc = {k: {"source": v["source"], "round": v["round"],
+                   "value": v["value"],
+                   "weak_efficiency": v.get("weak_efficiency"),
+                   "strong_efficiency": v.get("strong_efficiency")}
+               for k, v in sorted(best_known_multichip(entries).items())}
     return {"version": 1,
             "baseline_mrow_tree_per_s": 22.0,
             "entries": entries,
-            "best": best}
+            "best": best,
+            "best_multichip": best_mc}
 
 
 def write_ledger(root: str, out_path: Optional[str] = None,
@@ -216,6 +273,11 @@ def compare(candidate: Dict, entries: List[Dict],
     tol = dict(DEFAULT_TOLERANCES, **(tolerances or {}))
     problems: List[str] = []
     notes: List[str] = []
+    if (candidate.get("kind") == "multichip"
+            or candidate.get("metric") == "multichip_scaling"):
+        return compare_multichip(candidate, entries,
+                                 exclude_source=exclude_source,
+                                 tolerances=tolerances)
     c = candidate if candidate.get("kind") == "bench" else \
         normalize_bench(candidate, candidate.get("source", "<candidate>"),
                         candidate.get("round"))
@@ -260,6 +322,55 @@ def compare(candidate: Dict, entries: List[Dict],
                 f"peak-HBM regression: {c['hbm_peak_gb']} GB vs best-known "
                 f"{min_hbm} GB (+{tol['hbm']:.0%} band)")
         problems.extend(_cost_drift(c, b, tol["cost"]))
+    return problems, notes
+
+
+def compare_multichip(candidate: Dict, entries: List[Dict],
+                      exclude_source: Optional[str] = None,
+                      tolerances: Optional[Dict[str, float]] = None
+                      ) -> Tuple[List[str], List[str]]:
+    """Flag regressions of a ``multichip_scaling`` payload against the
+    measured multichip history: per-chip throughput below the tolerance
+    band, or scaling efficiency collapsing below best-known minus the band
+    — the gate the satellite 'per-chip throughput regressions fail make
+    bench-diff' names."""
+    tol = dict(DEFAULT_TOLERANCES, **(tolerances or {}))
+    problems: List[str] = []
+    notes: List[str] = []
+    c = candidate if candidate.get("kind") == "multichip" else \
+        normalize_multichip(candidate,
+                            candidate.get("source", "<candidate>"),
+                            candidate.get("round"))
+    if not _clean_multichip(c):
+        problems.append(
+            f"multichip candidate has no clean per-chip measurement "
+            f"(value={c.get('value')!r}, error={c.get('error')!r})")
+        return problems, notes
+    best = best_known_multichip(entries, exclude_source=exclude_source)
+    b = best.get(multichip_key(c))
+    if b is None:
+        notes.append(f"no comparable multichip history for "
+                     f"{multichip_key(c)} — nothing to regress against")
+        return problems, notes
+    floor = b["value"] * (1.0 - tol["throughput"])
+    if c["value"] < floor:
+        problems.append(
+            f"per-chip throughput regression: {c['value']} "
+            f"{c.get('unit') or ''} vs best-known {b['value']} "
+            f"({b['source']}) — below the {tol['throughput']:.0%} band "
+            f"floor {floor:.3g}")
+    else:
+        notes.append(f"per-chip throughput ok: {c['value']} vs best "
+                     f"{b['value']} ({b['source']})")
+    for field in ("weak_efficiency", "strong_efficiency"):
+        bv, cv = b.get(field), c.get(field)
+        # multiplicative band like the throughput check — an absolute
+        # delta would never fire for efficiencies below the tolerance
+        if bv is not None and cv is not None \
+                and cv < bv * (1.0 - tol["throughput"]):
+            problems.append(
+                f"scaling-efficiency regression: {field} {cv} vs "
+                f"best-known {bv} ({b['source']})")
     return problems, notes
 
 

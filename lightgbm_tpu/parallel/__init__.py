@@ -11,9 +11,10 @@ over ICI/DCN inside one jitted step:
 - root sums / scalars  -> `jax.lax.psum`
 """
 from .comm import (ParallelContext, SerialComm, DataParallelComm,
-                   FeatureParallelComm, VotingParallelComm, make_parallel_context)
+                   FeatureParallelComm, VotingParallelComm,
+                   choose_tree_learner, make_parallel_context)
 
 __all__ = [
     "ParallelContext", "SerialComm", "DataParallelComm", "FeatureParallelComm",
-    "VotingParallelComm", "make_parallel_context",
+    "VotingParallelComm", "choose_tree_learner", "make_parallel_context",
 ]

@@ -117,9 +117,14 @@ def find_block_splits(hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
 # serialized size of one slot's SplitCandidates leaves (the all-gather
 # argmax payload): gain/left_g/left_h/left_c f32 + feature/threshold i32 +
 # default_left/is_cat bool + the [B] bool cat_mask — the analog of the
-# reference's serialized SplitInfo (split_info.hpp Size())
-def _split_candidate_bytes(num_bins_padded: int) -> int:
-    return 4 * 4 + 2 * 4 + 2 + num_bins_padded
+# reference's serialized SplitInfo (split_info.hpp Size()). The cat_mask
+# only travels when categorical splits are possible: without them it is a
+# constant-zero array XLA folds out of the collective entirely (the round-6
+# measured-HLO validation caught the always-charged mask overestimating the
+# common numerical-only payload ~11x).
+def _split_candidate_bytes(num_bins_padded: int,
+                           use_categorical: bool = True) -> int:
+    return 4 * 4 + 2 * 4 + 2 + (num_bins_padded if use_categorical else 0)
 
 
 def _gather_argmax(cand: SplitCandidates, axis_name: str) -> SplitCandidates:
@@ -169,7 +174,8 @@ class SerialComm:
     def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
         return find_block_splits(hist, pg, ph, pc, bm, spec)
 
-    def collective_bytes(self, num_slots: int, num_bins_padded: int) -> dict:
+    def collective_bytes(self, num_slots: int, num_bins_padded: int,
+                         use_categorical: bool = True) -> dict:
         """Per-wave collective payload estimate in bytes, by collective —
         the MULTICHIP cost story (observability/costs.py publishes these as
         ``comm.bytes_per_wave.*`` gauges at booster construction). Serial
@@ -228,17 +234,27 @@ class DataParallelComm:
         return _gather_argmax(find_block_splits(hist, pg, ph, pc, bm, spec),
                               self.axis)
 
-    def collective_bytes(self, num_slots: int, num_bins_padded: int) -> dict:
+    def collective_bytes(self, num_slots: int, num_bins_padded: int,
+                         use_categorical: bool = True) -> dict:
         """Data-parallel pays the full-width histogram reduce-scatter every
         wave (the reference's ReduceScatter of HistogramBinEntry,
         data_parallel_tree_learner.cpp:148-163) plus the candidate
-        all-gather and one 3-scalar root psum per tree."""
+        all-gather and one 3-scalar root psum per tree.
+
+        The reduce-scatter covers the ``num_slots`` freshly-built
+        histograms (siblings derive locally by subtraction); the candidate
+        all-gather carries ``2 * num_slots`` rows — the split scan runs
+        over slot+sibling pairs (grower.py step 4 concatenates them), which
+        the round-6 measured-HLO validation (bench.py --multichip) pinned
+        after the original estimate undercounted by exactly 2x."""
+        scan_slots = 2 * num_slots
         return {
             "psum_root_scalars": 3 * 4,
             "psum_scatter_hist": (num_slots * self.num_features
                                   * num_bins_padded * 3 * 4),
-            "allgather_splits": (self.num_devices * num_slots
-                                 * _split_candidate_bytes(num_bins_padded)),
+            "allgather_splits": (self.num_devices * scan_slots
+                                 * _split_candidate_bytes(num_bins_padded,
+                                         use_categorical)),
         }
 
 
@@ -267,12 +283,15 @@ class FeatureParallelComm:
     block_meta = DataParallelComm.block_meta
     find_splits = DataParallelComm.find_splits
 
-    def collective_bytes(self, num_slots: int, num_bins_padded: int) -> dict:
+    def collective_bytes(self, num_slots: int, num_bins_padded: int,
+                         use_categorical: bool = True) -> dict:
         """Feature-parallel never moves histograms — rows are replicated,
-        so the only wave collective is the candidate all-gather."""
+        so the only wave collective is the candidate all-gather (over the
+        2*num_slots slot+sibling scan rows, like DataParallelComm)."""
         return {
-            "allgather_splits": (self.num_devices * num_slots
-                                 * _split_candidate_bytes(num_bins_padded)),
+            "allgather_splits": (self.num_devices * 2 * num_slots
+                                 * _split_candidate_bytes(num_bins_padded,
+                                         use_categorical)),
         }
 
 
@@ -337,12 +356,15 @@ class FeatureParallelBundledComm:
         return _gather_argmax(find_block_splits(hist, pg, ph, pc, bm, spec),
                               self.axis)
 
-    def collective_bytes(self, num_slots: int, num_bins_padded: int) -> dict:
+    def collective_bytes(self, num_slots: int, num_bins_padded: int,
+                         use_categorical: bool = True) -> dict:
         """Bundled feature-parallel: bundles are the partition unit but the
-        wave collective is still only the candidate all-gather."""
+        wave collective is still only the candidate all-gather (2*num_slots
+        slot+sibling scan rows)."""
         return {
-            "allgather_splits": (self.num_devices * num_slots
-                                 * _split_candidate_bytes(num_bins_padded)),
+            "allgather_splits": (self.num_devices * 2 * num_slots
+                                 * _split_candidate_bytes(num_bins_padded,
+                                         use_categorical)),
         }
 
 
@@ -435,32 +457,71 @@ class VotingParallelComm:
         feat = jnp.take_along_axis(sel, cand.feature[:, None], axis=1)[:, 0]
         return cand._replace(feature=feat.astype(jnp.int32))
 
-    def collective_bytes(self, num_slots: int, num_bins_padded: int) -> dict:
+    def collective_bytes(self, num_slots: int, num_bins_padded: int,
+                         use_categorical: bool = True) -> dict:
         """PV-Tree's O(k/F) trade made explicit: votes + gain ranks are
         [S, F] f32 psums, and only the ~2k winning features' histogram
         columns reduce (CopyLocalHistogram,
         voting_parallel_tree_learner.cpp:197) — compare psum_selected_hist
-        here against DataParallelComm's full psum_scatter_hist."""
+        here against DataParallelComm's full psum_scatter_hist. Every one
+        of these runs inside ``find_splits``, whose slot axis is the
+        2*num_slots slot+sibling scan (grower.py step 4)."""
         F = self.num_features
         k2 = min(2 * max(1, min(self.top_k, F)), F)
+        scan_slots = 2 * num_slots
         return {
             "psum_root_scalars": 3 * 4,
-            "psum_votes": num_slots * F * 4,
-            "psum_gain_ranks": num_slots * F * 4,
-            "psum_selected_hist": num_slots * k2 * num_bins_padded * 3 * 4,
-            "allgather_splits": (self.num_devices * num_slots
-                                 * _split_candidate_bytes(num_bins_padded)),
+            "psum_votes": scan_slots * F * 4,
+            "psum_gain_ranks": scan_slots * F * 4,
+            "psum_selected_hist": scan_slots * k2 * num_bins_padded * 3 * 4,
+            "allgather_splits": (self.num_devices * scan_slots
+                                 * _split_candidate_bytes(num_bins_padded,
+                                         use_categorical)),
         }
+
+
+def choose_tree_learner(num_data: int, num_features: int, n_devices: int,
+                        top_k: int = 20, mesh_axis: str = "auto") -> str:
+    """Resolve ``tree_learner=auto`` from the shape class — the reference's
+    Parallel-Learning-Guide table (docs/Parallel-Learning-Guide.rst there,
+    docs/Parallel-Learning-Guide.md here): few rows + many features ->
+    feature-parallel; many rows -> data-parallel (the common case); many
+    rows AND many features -> voting-parallel, but only when PV-Tree's
+    O(k/F) trade actually shrinks the wave collective (F >> top_k).
+
+    ``mesh_axis`` is the override knob (config ``tpu_mesh_axis``):
+    ``rows`` constrains the choice to the row-sharded strategies
+    (data/voting), ``features`` forces feature-parallel, ``auto`` lets the
+    shape class decide. Explicitly setting ``tree_learner`` bypasses this
+    function entirely.
+    """
+    if n_devices <= 1:
+        return "serial"
+    # shape-class thresholds: "large" rows means the per-device histogram
+    # pass dominates setup (row sharding pays off); "large" features means
+    # the full-width histogram collective is the wave bottleneck
+    large_data = num_data >= 1_000_000
+    large_feature = num_features >= 256
+    if mesh_axis == "features":
+        return "feature"
+    if large_data and large_feature and num_features >= 8 * max(top_k, 1):
+        return "voting"
+    if not large_data and large_feature and mesh_axis != "rows":
+        return "feature"
+    return "data"
 
 
 class ParallelContext:
     """Mesh + strategy + shardings for one Booster.
 
     ``strategy`` follows the reference's `tree_learner` values
-    (config.h TreeLearnerType): serial | feature | data | voting.
+    (config.h TreeLearnerType): serial | feature | data | voting. The 1-D
+    mesh axis is NAMED by the role the strategy gives it — ``rows`` for the
+    row-sharded strategies (data/voting), ``features`` for feature-parallel
+    (where ``hist_X`` block-slices columns by axis index) — so shardings,
+    telemetry, and HLO dumps all say which dataset dimension the mesh
+    splits.
     """
-
-    ROW_AXIS = "shard"
 
     def __init__(self, strategy: str, devices, top_k: int = 20):
         self.strategy = strategy
@@ -471,7 +532,39 @@ class ParallelContext:
             self.strategy = "serial"
             self.mesh = None
         else:
-            self.mesh = Mesh(np.array(self.devices), (self.ROW_AXIS,))
+            self.mesh = Mesh(np.array(self.devices), (self.axis_kind,))
+
+    @property
+    def axis_kind(self) -> str:
+        """Which dataset dimension the mesh axis shards: ``rows`` (data/
+        voting), ``features`` (feature-parallel), ``none`` (serial)."""
+        if self.strategy in ("data", "voting"):
+            return "rows"
+        if self.strategy == "feature":
+            return "features"
+        return "none"
+
+    @property
+    def ROW_AXIS(self) -> str:
+        """The mesh axis name comm objects close over (role-named; kept as
+        the historical attribute the shard_map specs were written against)."""
+        return self.axis_kind if self.mesh is not None else "rows"
+
+    def describe(self) -> dict:
+        """Host-side mesh facts for telemetry / bench JSON."""
+        return {"strategy": self.strategy,
+                "n_devices": self.num_devices,
+                "mesh_axis": self.axis_kind,
+                "multi_process": bool(self.multi_process),
+                "platform": self.devices[0].platform if self.devices else None}
+
+    def residency_key(self) -> tuple:
+        """Hashable fingerprint of everything that determines a device
+        array's placement under this context — the Dataset-level residency
+        cache (dataset.py ``device_put_cached``) keys on it so a booster
+        built over a different mesh/strategy never reuses a stale layout."""
+        return (self.strategy, self.axis_kind, self.num_devices,
+                tuple(str(d) for d in self.devices))
 
     @property
     def multi_process(self) -> bool:
@@ -515,6 +608,24 @@ class ParallelContext:
         if self.mesh is None or self.strategy == "feature":
             return None
         return NamedSharding(self.mesh, P(self.ROW_AXIS))
+
+    def sharding(self, kind: str = "repl"):
+        """NamedSharding for this context's resident training arrays, or
+        None on a single device (plain device_put). Kinds: ``rows`` ([N]
+        sharded), ``rows0`` ([N, F], rows on dim 0), ``rows1`` ([K, N],
+        rows on dim 1), ``repl`` (replicated). Row sharding only applies to
+        the row-sharded strategies; feature-parallel replicates rows like
+        the reference's FeatureParallel learner (every machine holds all
+        data, feature_parallel_tree_learner.cpp) and slices columns at
+        trace time instead."""
+        if self.mesh is None:
+            return None
+        if kind == "repl" or self.strategy == "feature":
+            spec = P()
+        else:
+            spec = {"rows": P(self.ROW_AXIS), "rows0": P(self.ROW_AXIS, None),
+                    "rows1": P(None, self.ROW_AXIS)}[kind]
+        return NamedSharding(self.mesh, spec)
 
     def shard_grow(self, grow_fn: Callable) -> Callable:
         """Wrap ``grow_fn(X, grad, hess, included, feature_ok, num_bins,
@@ -805,11 +916,17 @@ def select_devices(config):
     return jax.devices()
 
 
-def make_parallel_context(config, devices=None) -> ParallelContext:
+def make_parallel_context(config, devices=None, shape=None) -> ParallelContext:
     """Build the context from config (reference: Network::Init,
     application.cpp:167-178 — here the 'network' is the device mesh, and a
-    machine list triggers jax.distributed multi-host wiring)."""
+    machine list triggers jax.distributed multi-host wiring).
+
+    ``shape`` is an optional ``(num_data, num_features)`` hint that
+    ``tree_learner=auto`` resolves against (``choose_tree_learner``); the
+    booster passes its training matrix shape. Without a hint, auto falls
+    back to the reference's distributed default (data parallel)."""
     strategy = getattr(config, "tree_learner", "serial")
+    top_k = getattr(config, "top_k", 20)
     if devices is None:
         multi = init_distributed(config)
         devices = select_devices(config)
@@ -829,4 +946,20 @@ def make_parallel_context(config, devices=None) -> ParallelContext:
             devices = devices[: min(nm, len(devices))]
         elif strategy == "serial":
             devices = devices[:1]
-    return ParallelContext(strategy, devices, top_k=getattr(config, "top_k", 20))
+    if strategy == "auto":
+        from ..utils.log import Log
+        if shape is None:
+            strategy = "data" if len(devices) > 1 else "serial"
+            Log.warning("tree_learner=auto without a dataset shape hint; "
+                        "using tree_learner=%s", strategy)
+        else:
+            strategy = choose_tree_learner(
+                int(shape[0]), int(shape[1]), len(devices), top_k=top_k,
+                mesh_axis=getattr(config, "tpu_mesh_axis", "auto"))
+            Log.info("tree_learner=auto resolved to %s (%d rows x %d "
+                     "features over %d device(s), tpu_mesh_axis=%s)",
+                     strategy, shape[0], shape[1], len(devices),
+                     getattr(config, "tpu_mesh_axis", "auto"))
+        if strategy == "serial" and len(devices) > 1:
+            devices = devices[:1]
+    return ParallelContext(strategy, devices, top_k=top_k)

@@ -57,9 +57,11 @@ VOLATILE_CONFIG_FIELDS = frozenset({
     "is_save_binary_file", "verbose", "num_threads",
     # resuming a run LONGER than originally planned is the point
     "num_iterations",
-    # checkpointing's own knobs
+    # checkpointing's own knobs (tpu_reshard_on_resume included: it gates
+    # HOW a resume re-lays-out state, not what the model trains to — the
+    # device-count check itself lives in restore_checkpoint_state)
     "checkpoint_dir", "checkpoint_interval", "checkpoint_keep_last_n",
-    "resume_from",
+    "resume_from", "tpu_reshard_on_resume",
     # cluster wiring: the restarted pod gets fresh addresses/ports
     "machines", "machine_list_file", "local_listen_port", "time_out",
     # profiling/telemetry (observability/: spans, exporters, profiler window)

@@ -10,6 +10,20 @@ dry-run mode, and the driver dryrun (all previously private copies).
 from __future__ import annotations
 
 import os
+import re
+
+
+def force_device_count_flags(flags: str, device_count: int | None) -> str:
+    """XLA_FLAGS with the virtual-host-device count set to exactly
+    ``device_count`` (any pre-existing count is REPLACED, never kept —
+    the one home of this flag dance for in-process arming and for child
+    environments alike; ``None`` just strips a stale flag)."""
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   flags or "").strip()
+    if device_count is not None:
+        flags = (flags + f" --xla_force_host_platform_device_count"
+                         f"={device_count}").strip()
+    return flags
 
 
 def force_cpu_backend(device_count: int | None = None) -> None:
@@ -19,11 +33,8 @@ def force_cpu_backend(device_count: int | None = None) -> None:
     initialize lazily). Safe to call repeatedly.
     """
     if device_count is not None:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count"
-                        f"={device_count}").strip()
+        os.environ["XLA_FLAGS"] = force_device_count_flags(
+            os.environ.get("XLA_FLAGS", ""), device_count)
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     from jax._src import xla_bridge

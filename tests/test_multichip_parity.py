@@ -1,0 +1,315 @@
+"""Multichip training under forced 8-device CPU (tier-1 wiring of the
+round-6 measured-multichip work, docs/TPU-Performance.md "Multi-chip"):
+
+- data-parallel training matches serial within the established parity gap,
+  INCLUDING through the fused tree_batch scan (sharded residency flows
+  through the whole lax.scan, not just per-call shard_map) — and the fused
+  data-parallel path is bit-identical to its own per-tree dispatch;
+- feature/voting smoke-train in the same harness (feature bit-exact vs
+  serial is pinned separately in test_parallel.py);
+- tree_learner=auto resolves the mesh axis from the shape class with the
+  tpu_mesh_axis override knob (parallel/comm.py choose_tree_learner);
+- the binned dataset's device residency is first-class: boosters over the
+  same mesh share the SAME on-device code-matrix buffers;
+- checkpoint/resume across device counts is rejected loudly (or re-sharded
+  deliberately under tpu_reshard_on_resume);
+- measured collective bytes (compiled-HLO scan, observability/costs.py)
+  agree with the analytic parallel/comm.py estimates within band.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel.comm import (ParallelContext, choose_tree_learner,
+                                        make_parallel_context)
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _make_regression(n=2000, f=10, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 3) + (X[:, 2] > 0.4) * 1.5 \
+        + 0.1 * rng.randn(n)
+    return X, y
+
+
+BASE = dict(objective="regression", num_leaves=15, learning_rate=0.1,
+            min_data_in_leaf=5, device="cpu", verbose=-1)
+
+
+# ---------------------------------------------------- serial parity (fused)
+
+def test_data_parallel_fused_batch_matches_serial():
+    """The acceptance gate: 8-device data-parallel training through the
+    FUSED tree_batch scan stays within the established serial parity gap
+    (f32 reduction-order noise — the reference's ReduceScatter sums in a
+    different order than one machine would)."""
+    X, y = _make_regression()
+    p_serial = lgb.train(dict(BASE, tree_learner="serial"),
+                         lgb.Dataset(X, label=y),
+                         num_boost_round=20).predict(X)
+    p_fused = lgb.train(dict(BASE, tree_learner="data", tree_batch=4),
+                        lgb.Dataset(X, label=y),
+                        num_boost_round=20).predict(X)
+    np.testing.assert_allclose(p_serial, p_fused, rtol=1e-4, atol=1e-4)
+
+
+def test_data_parallel_fused_bitexact_vs_per_tree():
+    """tree_batch=4 under the 8-device mesh is BIT-identical to the same
+    sharded training dispatched per tree — the fused scan carries the
+    sharded scores/masks without perturbing the math (the incremental-
+    partition-style pin, now over the mesh)."""
+    X, y = _make_regression()
+    p1 = lgb.train(dict(BASE, tree_learner="data", tree_batch=1),
+                   lgb.Dataset(X, label=y), num_boost_round=12).predict(X)
+    p4 = lgb.train(dict(BASE, tree_learner="data", tree_batch=4),
+                   lgb.Dataset(X, label=y), num_boost_round=12).predict(X)
+    np.testing.assert_array_equal(p1, p4)
+
+
+@pytest.mark.parametrize("strategy", ["feature", "voting"])
+def test_fused_batch_smoke_other_strategies(strategy):
+    """feature/voting train through the fused scan on the same harness and
+    produce finite, useful models."""
+    X, y = _make_regression()
+    bst = lgb.train(dict(BASE, tree_learner=strategy, tree_batch=2),
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    p = bst.predict(X)
+    assert np.isfinite(p).all()
+    assert np.mean((p - y) ** 2) < np.var(y) * 0.6
+
+
+# ------------------------------------------------------- auto mesh selection
+
+def test_choose_tree_learner_shape_classes():
+    # reference Parallel-Learning-Guide table
+    assert choose_tree_learner(10_000, 50, 1) == "serial"
+    assert choose_tree_learner(5_000_000, 28, 8) == "data"
+    assert choose_tree_learner(200_000, 1000, 8) == "feature"
+    assert choose_tree_learner(5_000_000, 1000, 8, top_k=20) == "voting"
+    # voting only pays off when F >> top_k; otherwise rows shard plainly
+    assert choose_tree_learner(5_000_000, 1000, 8, top_k=500) == "data"
+    # the override knob constrains the axis side of the choice
+    assert choose_tree_learner(200_000, 1000, 8, mesh_axis="rows") == "data"
+    assert choose_tree_learner(5_000_000, 28, 8,
+                               mesh_axis="features") == "feature"
+
+
+def test_auto_learner_resolves_and_trains():
+    X, y = _make_regression()
+    bst = lgb.train(dict(BASE, tree_learner="auto"), lgb.Dataset(X, label=y),
+                    num_boost_round=8, keep_training_booster=True)
+    # small data, small features -> row sharding over the full CPU mesh
+    assert bst._gbdt.pctx.strategy == "data"
+    assert bst._gbdt.pctx.axis_kind == "rows"
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_mesh_axis_names_follow_strategy():
+    for strategy, axis in (("data", "rows"), ("voting", "rows"),
+                           ("feature", "features")):
+        cfg = Config.from_params(dict(tree_learner=strategy, device="cpu"))
+        pctx = make_parallel_context(cfg)
+        assert pctx.axis_kind == axis
+        assert pctx.mesh.axis_names == (axis,)
+        assert pctx.describe()["n_devices"] == 8
+    assert ParallelContext("serial", []).axis_kind == "none"
+
+
+# -------------------------------------------------------- sharded residency
+
+def test_dataset_residency_shared_across_boosters():
+    """The binned code matrix lives on the mesh ONCE per dataset: a second
+    booster over the same mesh/padding reuses the same device buffers
+    instead of re-uploading (dataset.device_put_cached)."""
+    X, y = _make_regression()
+    params = dict(BASE, tree_learner="data")
+    ds = lgb.Dataset(X, label=y, params=params)
+    b1 = lgb.Booster(params=params, train_set=ds)
+    b2 = lgb.Booster(params=params, train_set=ds)
+    assert b1._gbdt.Xb is b2._gbdt.Xb
+    assert b1._gbdt.pad_mask is b2._gbdt.pad_mask
+    # identical training on both proves the shared constants are untouched
+    b1.update()
+    b2.update()
+    np.testing.assert_array_equal(np.asarray(b1._gbdt.score),
+                                  np.asarray(b2._gbdt.score))
+    # a different strategy (different sharding) must NOT reuse the buffers
+    p_ser = dict(BASE, tree_learner="serial")
+    ds2 = lgb.Dataset(X, label=y, params=p_ser)
+    b3 = lgb.Booster(params=p_ser, train_set=ds2)
+    assert b3._gbdt.Xb is not b1._gbdt.Xb
+
+
+def test_sharded_score_and_codes_on_mesh():
+    """Scores, gradients' source, and the code matrix really carry the
+    row sharding (NamedSharding over the 'rows' axis) — residency, not
+    resharding at dispatch."""
+    X, y = _make_regression()
+    params = dict(BASE, tree_learner="data")
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params=params, train_set=ds)
+    g = bst._gbdt
+    for arr in (g.Xb, g.score, g.pad_mask):
+        assert "rows" in str(arr.sharding.spec), arr.sharding
+        assert not arr.is_fully_replicated
+    bst.update()
+    assert "rows" in str(g.score.sharding.spec)
+
+
+# ------------------------------------------- checkpoint across device counts
+
+def _checkpoint_pair(n=1000):
+    """A trained 8-device data-parallel booster + a serial booster over the
+    same data (whose padded layouts genuinely differ at this N)."""
+    X, y = _make_regression(n=n)
+    p8 = dict(BASE, tree_learner="data")
+    b8 = lgb.Booster(params=p8, train_set=lgb.Dataset(X, label=y, params=p8))
+    for _ in range(3):
+        b8.update()
+    p1 = dict(BASE, tree_learner="serial")
+    b1 = lgb.Booster(params=p1, train_set=lgb.Dataset(X, label=y, params=p1))
+    return b8, b1, X
+
+
+def test_resume_rejects_device_count_change():
+    b8, b1, _X = _checkpoint_pair()
+    state = b8._gbdt.checkpoint_state()
+    assert state["n_devices"] == 8
+    assert b1._gbdt.num_data_padded != b8._gbdt.num_data_padded
+    with pytest.raises(LightGBMError, match="device"):
+        b1._gbdt.restore_checkpoint_state(state)
+
+
+def test_resume_reshards_deliberately():
+    """tpu_reshard_on_resume=true re-lays-out the global training state onto
+    the new mesh: the restored forest predicts identically and training
+    continues with finite results."""
+    b8, b1, X = _checkpoint_pair()
+    state = b8._gbdt.checkpoint_state()
+    b1._gbdt.config = b1._gbdt.config.replace(tpu_reshard_on_resume=True)
+    b1._gbdt.restore_checkpoint_state(state)
+    assert b1._gbdt.iter_ == b8._gbdt.iter_
+    b8._finalize()
+    b1._finalize()
+    np.testing.assert_allclose(b1.predict(X), b8.predict(X),
+                               rtol=1e-6, atol=1e-6)
+    b1.update()        # continued training on the new mesh stays healthy
+    assert np.isfinite(np.asarray(b1._gbdt.score)).all()
+
+
+# ------------------------------------------- measured vs analytic collectives
+
+def test_measured_collectives_match_analytic_band():
+    """The compiled train step's HLO collectives (the MEASURED side,
+    costs.hlo_collectives) agree with the analytic parallel/comm.py
+    collective_bytes estimates within the >2x band the round-6 satellite
+    fixed — the reduce-scatter and all-gather dominate and must map 1:1."""
+    from lightgbm_tpu import observability as obs
+    from lightgbm_tpu.observability import costs
+    obs.reset_for_tests()
+    try:
+        costs.configure(enabled=True)
+        X, y = _make_regression()
+        params = dict(BASE, tree_learner="data", tree_batch=1,
+                      tpu_hist_kernel="xla")
+        ds = lgb.Dataset(X, label=y, params=params)
+        bst = lgb.Booster(params=params, train_set=ds)
+        bst.update()
+        rep = costs.report("train_step.k1")
+        assert rep and rep.get("collectives"), rep
+        coll = rep["collectives"]
+        assert "reduce-scatter" in coll and "all-gather" in coll
+        g = bst._gbdt
+        analytic = g.comm.collective_bytes(
+            g.spec.hist_slots, g.spec.num_bins_padded,
+            use_categorical=g.spec.use_categorical)
+        wire = costs.collective_wire_bytes(coll, g.pctx.num_devices)
+        # reduce-scatter wire ~ (D-1)/D x the full analytic payload
+        ratio_rs = wire["reduce-scatter"] / analytic["psum_scatter_hist"]
+        assert 0.5 < ratio_rs < 2.0, (wire, analytic)
+        # all-gather wire ~ (D-1)/D x the gathered candidate payload
+        ratio_ag = wire["all-gather"] / analytic["allgather_splits"]
+        assert 0.5 < ratio_ag < 2.0, (wire, analytic)
+    finally:
+        obs.reset_for_tests()
+
+
+def test_hlo_collectives_async_tuple_counts_result_half_only():
+    """TPU lowers async collectives as tuple-shaped `-start` ops
+    ((aliased operands..., results...)); only the result half is the
+    transfer — counting both would double-count (2x for all-reduce-start).
+    The sync (non-tuple) form and `-done` lines stay as-is."""
+    from lightgbm_tpu.observability.costs import hlo_collectives
+    text = "\n".join([
+        "  %ar = (f32[64]{0}, f32[64]{0}) all-reduce-start(f32[64]{0} %p),"
+        " replica_groups={{0,1}}, to_apply=%sum",
+        "  %ard = f32[64]{0} all-reduce-done((f32[64]{0}, f32[64]{0}) %ar)",
+        "  %ag = (f32[1,8]{1,0}, f32[8,8]{1,0}) all-gather-start"
+        "(f32[1,8]{1,0} %q), dimensions={0}",
+        "  %sync = f32[32]{0} all-reduce(f32[32]{0} %r), to_apply=%sum",
+    ])
+    c = hlo_collectives(text)
+    # async all-reduce: result half only (64 f32 = 256 B), done not counted
+    assert c["all-reduce"]["instances"] == 2
+    assert c["all-reduce"]["output_bytes"] == 64 * 4 + 32 * 4
+    # async all-gather: gathered result [8,8] only, not the [1,8] operand
+    assert c["all-gather"]["output_bytes"] == 8 * 8 * 4
+    # real-TPU shapes: tiled layouts put parens INSIDE the tuple shape, and
+    # collective-permute-start carries u32[] context scalars that are
+    # neither operand nor result
+    tpu = "\n".join([
+        "  %ar = (f32[1024]{0:T(1024)}, f32[1024]{0:T(1024)}) "
+        "all-reduce-start(f32[1024]{0:T(1024)} %p), to_apply=%sum",
+        "  %cp = (f32[64]{0:T(64)}, f32[64]{0:T(64)}, u32[]{:T(128)}, "
+        "u32[]{:T(128)}) collective-permute-start(f32[64]{0:T(64)} %q), "
+        "source_target_pairs={{0,1}}",
+    ])
+    ct = hlo_collectives(tpu)
+    assert ct["all-reduce"]["output_bytes"] == 1024 * 4
+    assert ct["collective-permute"]["output_bytes"] == 64 * 4
+
+
+# ---------------------------------------------------------- multichip ledger
+
+def test_multichip_ledger_normalize_and_compare():
+    from lightgbm_tpu.observability import ledger
+    payload = {"metric": "multichip_scaling", "platform": "cpu",
+               "simulated": True, "tree_learner": "data", "n_devices": 8,
+               "rows_per_device": 16000, "ok": True,
+               "per_chip_mrow_tree_per_s": 0.5, "weak_efficiency": 0.8,
+               "strong_efficiency": 0.7}
+    e = ledger.normalize_multichip(payload, "MULTICHIP_r90.json", 90)
+    assert e["value"] == 0.5 and e["kind"] == "multichip"
+    assert "n_devices=8" in ledger.multichip_key(e)
+    # regression: per-chip throughput below the band fails
+    bad = dict(payload, per_chip_mrow_tree_per_s=0.2)
+    problems, _ = ledger.compare(bad, [e])
+    assert any("per-chip throughput regression" in p for p in problems)
+    # clean candidate passes; efficiency collapse is flagged
+    ok_cand = dict(payload, per_chip_mrow_tree_per_s=0.48)
+    problems, notes = ledger.compare(ok_cand, [e])
+    assert problems == [] and any("per-chip throughput ok" in n
+                                  for n in notes)
+    slow = dict(payload, weak_efficiency=0.4)
+    problems, _ = ledger.compare(slow, [e])
+    assert any("scaling-efficiency regression" in p for p in problems)
+    # dry-run wrappers (rounds 1-5) normalize without a value and never
+    # enter the gate
+    old = ledger.normalize_multichip({"n_devices": 8, "rc": 0, "ok": True},
+                                     "MULTICHIP_r05.json", 5)
+    assert old["value"] is None
+
+
+def test_bench_comparability_key_carries_n_devices():
+    from lightgbm_tpu.observability import ledger
+    e = ledger.normalize_bench({"value": 1.0, "platform": "cpu",
+                                "rows": 100, "n_devices": 8},
+                               "BENCH_r91.json", 91)
+    assert ledger.comparability_key(e).endswith("|n_devices=8")
+    # single-chip history (no field) stays in its own group
+    e0 = ledger.normalize_bench({"value": 1.0, "platform": "cpu",
+                                 "rows": 100}, "BENCH_r90.json", 90)
+    assert ledger.comparability_key(e0).endswith("|n_devices=None")
+    assert ledger.comparability_key(e) != ledger.comparability_key(e0)
