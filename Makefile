@@ -10,18 +10,32 @@
 # compile cache in .jax_cache; `check-fast` is ~4 min cold.
 PYTEST := python -m pytest -q
 
-# Static JAX/TPU hygiene pass (rules R001-R008, see docs/Static-Analysis.md).
+# Static JAX/TPU hygiene pass (rules R001-R009, see docs/Static-Analysis.md).
 # Exits non-zero on any finding not covered by tpu_lint_baseline.json.
 lint:
 	python -m lightgbm_tpu.analysis lightgbm_tpu/
 
 # CI gate: lint + tier-1 tests + the recompile guard on a 5-iter smoke run
 # (which also asserts checkpoint save/resume stays recompile-free and pins
-# the fused step's FLOPs/bytes to golden values) + the perf-ledger diff.
+# the fused step's FLOPs/bytes to golden values) + the out-of-core stream
+# smoke (small N, forced budget -> tpu_residency=stream; asserts 0
+# recompiles and bit-identity with the resident output) + the perf-ledger
+# diff.
 verify: lint
 	env JAX_PLATFORMS=cpu $(PYTEST) tests/ -m 'not slow'
 	python bench.py --smoke
+	$(MAKE) stream
 	$(MAKE) bench-diff
+
+# Out-of-core streaming smoke (docs/TPU-Performance.md "Out-of-core
+# streaming"): hermetic-CPU train of a dataset >= 4x an artificial HBM
+# budget with tpu_residency auto-falling back to stream — asserts the
+# streamed run is bit-identical to device residency, steady-state waves
+# add 0 recompiles, and reports throughput + prefetch-stall fraction vs
+# the resident arm. Bigger N: LGBM_TPU_STREAM_ROWS=500000 make stream.
+stream:
+	env LGBM_TPU_STREAM_ROWS=20000 LGBM_TPU_STREAM_ITERS=5 \
+	    python bench.py --stream
 
 # Perf regression gate (docs/TPU-Performance.md): assert the committed
 # PERF_LEDGER.json matches the checked-in BENCH_*/MULTICHIP_* history (no
@@ -75,4 +89,4 @@ trace:
 	@echo "trace: $$(ls -1t .telemetry/trace_*.json | head -1)"
 
 .PHONY: lint verify check-fast check capi bench-cpu chaos trace bench-diff \
-        ledger multichip
+        ledger multichip stream

@@ -499,6 +499,7 @@ def run_bench(deadline, attempt=0, platform=None):
                     "platform": platform,
                     "rows": quick_rows,
                     "kernel": bq._gbdt.spec.hist_kernel,
+                    "residency": bq._gbdt.residency,
                     "attempt": attempt,
                     "phase_timings": timings,
                     "note": ("quick-scale pre-bank; the full-scale phase "
@@ -551,6 +552,7 @@ def run_bench(deadline, attempt=0, platform=None):
         "platform": platform,
         "rows": n_rows,
         "kernel": kernel_resolved,
+        "residency": bst._gbdt.residency,
         "attempt": attempt,
         **({"hist_slots": slots} if slots else {}),
         "tree_batch": bst._gbdt.tree_batch,
@@ -1300,6 +1302,176 @@ def run_smoke():
     return 0 if (ok and resume_ok and cache_ok and tel_ok and cost_ok) else 1
 
 
+# ------------------------------------------------------------ stream phase
+
+def run_stream(argv=None):
+    """`bench.py --stream`: the out-of-core streaming phase
+    (tpu_residency=stream, ops/stream.py; docs/TPU-Performance.md
+    "Out-of-core streaming"). Hermetic CPU, like --smoke. What it proves:
+
+    1. AUTO FALLBACK — an artificial per-device HBM budget is configured
+       at 1/4 of the raw binned-code bytes, so the dataset is >= 4x the
+       budget and ``tpu_residency=auto`` must resolve to stream (asserted).
+    2. IDENTITY — the streamed run's predictions are BIT-identical to the
+       device-resident run on the same data (tpu_row_compact=false arm).
+    3. 0-RECOMPILE — the streamed steady-state wave loop adds zero jit
+       cache misses after warm-up (RecompileGuard over every streamed
+       entrypoint).
+    4. MEASURED OVERLAP — throughput streamed vs resident, the prefetch
+       stall fraction (stall seconds / streamed steady seconds), and a
+       forced no-prefetch arm (LGBM_TPU_STREAM_NO_PREFETCH) so the double
+       buffer's win is a measured delta, not an assumption.
+
+    Prints ONE JSON line (bench schema + stream extras; residency=stream
+    keys it into its own perf-ledger comparability class); exit 0 iff the
+    identity + guard assertions hold. LGBM_TPU_STREAM_OUT writes the same
+    payload to a file for banking as STREAM_r<N>.json."""
+    from lightgbm_tpu.utils.hermetic import force_cpu_backend
+    force_cpu_backend()
+    import time
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis.guards import GuardViolation, RecompileGuard
+
+    n_rows = int(os.environ.get("LGBM_TPU_STREAM_ROWS", "60000"))
+    iters = int(os.environ.get("LGBM_TPU_STREAM_ITERS", "8"))
+    warmup = 2
+    X, y = _higgs_like(n_rows)
+    # budget = raw binned-code bytes / 4: the dataset alone is >= 4x it
+    budget = max(1, (n_rows * X.shape[1]) // 4)
+    base = dict(objective="binary", num_leaves=31, max_bin=63,
+                learning_rate=0.1, min_data_in_leaf=20, verbose=-1,
+                metric="none", tpu_hist_kernel="xla", tpu_hist_chunk=8192,
+                tpu_row_compact=False, seed=11)
+
+    def build(params):
+        ds = lgb.Dataset(X, label=y, params=params)
+        return lgb.Booster(params=params, train_set=ds)
+
+    def timed_loop(bst):
+        for _ in range(warmup):
+            bst.update()
+        np.asarray(bst._gbdt.score).sum()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            bst.update()
+        np.asarray(bst._gbdt.score).sum()
+        return time.perf_counter() - t0
+
+    out = {"metric": "stream_train_throughput", "unit": "Mrow-tree/s",
+           "platform": "cpu", "rows": n_rows, "iters": iters,
+           "kernel": "xla", "residency": "stream", "n_devices": 1,
+           "hbm_budget_bytes": budget}
+    ok, err = True, []
+
+    # ---- resident arm (the identity + throughput baseline) -----------------
+    b_dev = build(dict(base, tpu_residency="device"))
+    t_dev = timed_loop(b_dev)
+    tp_dev = n_rows * iters / t_dev / 1e6
+    out["resident_mrow_tree_per_s"] = _round_tp(tp_dev)
+
+    # ---- streamed arm: auto fallback + guard + stall accounting ------------
+    b_st = build(dict(base, tpu_residency="auto",
+                      tpu_hbm_budget_bytes=budget))
+    g = b_st._gbdt
+    if g.residency != "stream":
+        ok = False
+        err.append(f"auto residency resolved to {g.residency!r}, expected "
+                   f"stream (budget={budget})")
+    else:
+        store = g._stream_store
+        raw_bytes = store.n_rows_padded * store.num_cols
+        out["dataset_bytes"] = raw_bytes
+        out["stream"] = store.describe()
+        if raw_bytes < 4 * budget:
+            ok = False
+            err.append(f"dataset {raw_bytes} B is not >= 4x the "
+                       f"{budget} B budget")
+        pf = g._stream
+        guard = RecompileGuard(label="stream")
+        for _ in range(warmup):
+            b_st.update()
+        np.asarray(g.score).sum()
+        for name, fn in g._streamed_grower.jit_entrypoints():
+            guard.register(fn, name)
+        for name in ("pre", "prep", "shrink", "apply"):
+            guard.register(g._stream_fns[name], name)
+        stalls0, stall_s0 = pf.stalls, pf.stall_seconds
+        bytes0 = pf.bytes_h2d
+        try:
+            with guard:
+                guard.mark_warm()
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    b_st.update()
+                np.asarray(g.score).sum()
+                t_st = time.perf_counter() - t0
+        except GuardViolation as e:
+            ok = False
+            err.append(str(e)[:300])
+            t_st = float("nan")
+        rep = guard.report()
+        out["recompiles_post_warmup"] = rep["post_warmup_cache_misses"]
+        # a guard violation leaves t_st = nan — keep the one-JSON-line
+        # contract (bare NaN is not valid JSON) by nulling derived metrics
+        finite = t_st > 0          # False for nan
+        tp_st = n_rows * iters / t_st / 1e6 if finite else None
+        out["value"] = _round_tp(tp_st) if finite else None
+        out["stream_vs_resident"] = _round_ratio(tp_st / tp_dev) \
+            if finite else None
+        out["stream_bytes_h2d"] = pf.bytes_h2d - bytes0
+        out["prefetch_stalls"] = pf.stalls - stalls0
+        out["prefetch_stall_fraction"] = round(
+            (pf.stall_seconds - stall_s0) / t_st, 4) if finite else None
+        # identity: streamed === resident, bit for bit
+        ps, pd = b_st.predict(X), b_dev.predict(X)
+        out["identical_to_resident"] = bool(np.array_equal(ps, pd))
+        if not out["identical_to_resident"]:
+            ok = False
+            err.append(f"streamed predictions differ from resident "
+                       f"(max abs diff {float(np.max(np.abs(ps - pd)))})")
+
+        # ---- forced no-prefetch arm: the overlap, measured -----------------
+        os.environ["LGBM_TPU_STREAM_NO_PREFETCH"] = "1"
+        try:
+            b_np = build(dict(base, tpu_residency="stream",
+                              tpu_stream_shard_rows=(
+                                  store.local_shard_rows)))
+            for _ in range(warmup):
+                b_np.update()
+            np.asarray(b_np._gbdt.score).sum()
+            pf_np = b_np._gbdt._stream
+            # stall baseline AFTER warm-up: the fraction must cover the
+            # timed window only (the streamed arm subtracts the same way)
+            np_stall0 = pf_np.stall_seconds
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                b_np.update()
+            np.asarray(b_np._gbdt.score).sum()
+            t_np = time.perf_counter() - t0
+            out["no_prefetch_mrow_tree_per_s"] = _round_tp(
+                n_rows * iters / t_np / 1e6)
+            out["overlap_speedup_vs_no_prefetch"] = \
+                _round_ratio(t_np / t_st) if finite else None
+            out["no_prefetch_stall_fraction"] = round(
+                (pf_np.stall_seconds - np_stall0) / t_np, 4)
+            del b_np
+        finally:
+            os.environ.pop("LGBM_TPU_STREAM_NO_PREFETCH", None)
+
+    out["ok"] = ok
+    if err:
+        out["error"] = "; ".join(err)[:500]
+    print(json.dumps(out))
+    out_path = os.environ.get("LGBM_TPU_STREAM_OUT", "")
+    if out_path:
+        # the one atomic JSON writer (observability/export.py, pid-suffixed
+        # tmp — concurrent runs never clobber each other's in-flight file)
+        from lightgbm_tpu.observability.export import atomic_write_json
+        atomic_write_json(out_path, out)
+    return 0 if ok else 1
+
+
 # --------------------------------------------------------------- multichip
 
 def _multichip_child_env(d, platform, cache_dir):
@@ -1660,6 +1832,24 @@ def run_compare(argv):
                                 "problems": mp, "notes": mn, "ok": not mp}
             problems = problems + mp
             break
+        # ... and the newest banked STREAM result (bench.py --stream):
+        # residency=stream keys it into its own comparability class, so a
+        # streamed throughput regression fails here without ever being
+        # judged against device-resident numbers
+        for p in reversed(sorted(
+                _glob.glob(os.path.join(repo, "STREAM_r*.json")))):
+            pl = perf_ledger.payload_of(p)
+            if not pl or pl.get("residency") != "stream":
+                continue
+            sp, sn = perf_ledger.compare(
+                pl, entries, exclude_source=os.path.basename(p))
+            out["stream"] = {"candidate": os.path.basename(p),
+                             "value": pl.get("value"),
+                             "identical_to_resident":
+                                 pl.get("identical_to_resident"),
+                             "problems": sp, "notes": sn, "ok": not sp}
+            problems = problems + sp
+            break
     out["problems"] = problems
     out["ok"] = not problems
     print(json.dumps(out))
@@ -1671,6 +1861,8 @@ if __name__ == "__main__":
         run_sparse_phase()
     elif "--smoke" in sys.argv:
         sys.exit(run_smoke())
+    elif "--stream" in sys.argv:
+        sys.exit(run_stream(sys.argv))
     elif "--compare" in sys.argv:
         sys.exit(run_compare(sys.argv))
     elif "--multichip-child" in sys.argv:

@@ -8,6 +8,7 @@ from .static_args import StaticArgsRule            # R005
 from .import_exec import ImportExecRule            # R006
 from .sort_in_loop import SortInLoopRule           # R007
 from .ad_hoc_timing import AdHocTimingRule         # R008
+from .device_transfer import DeviceTransferRule    # R009
 
 _RULES = None
 
@@ -17,5 +18,5 @@ def active_rules():
     if _RULES is None:
         _RULES = [ControlFlowRule(), HostSyncRule(), DtypePromotionRule(),
                   PallasShapeRule(), StaticArgsRule(), ImportExecRule(),
-                  SortInLoopRule(), AdHocTimingRule()]
+                  SortInLoopRule(), AdHocTimingRule(), DeviceTransferRule()]
     return _RULES

@@ -1,0 +1,117 @@
+"""R009: jax.device_put/device_get reachable from wave-loop or scan bodies.
+
+A host->device (or device->host) transfer issued from inside a traced
+``lax.while_loop``/``lax.scan`` body is either a trace-time constant
+capture (silently baking one shard of data into the executable) or — in
+host-driven loops — an unmanaged per-iteration copy that bypasses the
+double-buffered prefetcher. The out-of-core streaming mode
+(tpu_residency=stream) exists precisely so mid-loop H2D traffic has ONE
+home with stall accounting, overlap, and byte counters:
+``ops/stream.py``'s ShardPrefetcher, fed by ``dataset.py``'s residency
+cache. Those two files are exempt; a ``device_put`` reachable from a loop
+body anywhere else is a finding.
+
+Detection reuses R007's intra-module reachability walk: callables handed
+to ``lax.while_loop`` OR ``lax.scan`` (by name or inline lambda) are
+roots; any same-file function they reference is reachable; a
+``jax.device_put``/``jax.device_get`` (or ``device_put``/``device_get``
+imported from jax) call in reachable code fires. Cross-module calls are
+invisible to the AST pass (documented limitation shared with R007);
+intentional sites belong in ``tpu_lint_baseline.json``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .common import dotted_name
+from .sort_in_loop import _local_defs, _referenced_names
+
+RULE_ID = "R009"
+
+_LOOP_CALLS = {"jax.lax.while_loop", "lax.while_loop",
+               "jax.lax.scan", "lax.scan"}
+_TRANSFER_DOTTED = {"jax.device_put", "jax.device_get"}
+_TRANSFER_FROM = {"device_put", "device_get"}
+
+# the sanctioned homes of managed transfers (module doc)
+_EXEMPT_MARKERS = ("ops/stream.py", "lightgbm_tpu/dataset.py")
+
+
+def _exempt(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    return any(rel.endswith(m) or m in rel for m in _EXEMPT_MARKERS)
+
+
+def _from_jax_aliases(tree) -> set:
+    """Local names bound by ``from jax import device_put[ as x]``."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name in _TRANSFER_FROM:
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+class DeviceTransferRule:
+    rule_id = RULE_ID
+    summary = ("jax.device_put/device_get reachable from a lax.while_loop "
+               "or lax.scan body outside ops/stream.py / dataset.py — "
+               "mid-loop transfers belong to the streaming prefetcher")
+
+    def check(self, ctx):
+        if _exempt(ctx.rel):
+            return
+        defs = _local_defs(ctx.tree)
+        aliases = _from_jax_aliases(ctx.tree)
+
+        # roots: callables handed to while_loop/scan (positional or kw)
+        roots = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in _LOOP_CALLS:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    roots.append(arg)
+                else:
+                    name = dotted_name(arg)
+                    if name in defs:
+                        roots.append(defs[name])
+        if not roots:
+            return
+
+        # reachability over same-file defs via loaded names (R007's walk)
+        reachable, frontier = [], list(roots)
+        seen = set()
+        while frontier:
+            fn = frontier.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            reachable.append(fn)
+            for name in _referenced_names(fn):
+                target = defs.get(name)
+                if target is not None and id(target) not in seen:
+                    frontier.append(target)
+
+        reported = set()
+        for fn in reachable:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                hit = name in _TRANSFER_DOTTED or \
+                    (name in aliases and "." not in name)
+                if hit and id(node) not in reported:
+                    reported.add(id(node))
+                    where = getattr(fn, "name", "<lambda>")
+                    yield ctx.finding(
+                        self.rule_id, node,
+                        f"`{name}()` reachable from a while_loop/scan body "
+                        f"(via `{where}`) — a transfer inside a traced "
+                        f"loop bakes data into the executable or bypasses "
+                        f"the streaming prefetcher; route it through "
+                        f"ops/stream.py's ShardPrefetcher (or the "
+                        f"dataset.py residency cache) instead")

@@ -412,6 +412,12 @@ class Booster:
         train_set.construct(self.config)
         cd = train_set.constructed
         self._gbdt = create_boosting(self.config, cd)
+        # the booster may normalize config fields to their EFFECTIVE values
+        # during construction (tpu_residency=stream forces
+        # tpu_row_compact=false) — adopt them so the checkpoint fingerprint
+        # covers what actually trains, and a streamed run resumes into a
+        # device-resident one with matching math
+        self.config = self._gbdt.config
         self.train_dataset = train_set
         self.feature_names = cd.feature_names
         self.num_total_features = cd.num_total_features

@@ -28,6 +28,9 @@ class DART(GBDT):
     # cannot fuse into a device-resident scan — GBDT.__init__ falls back to
     # tree_batch=1 with a warning
     supports_tree_batch = False
+    # the drop-set replay reads the RESIDENT code matrix per tree
+    # (_contrib_fn over self.Xb) — out-of-core streaming has no such array
+    supports_stream = False
 
     def __init__(self, config: Config, train_set, objective=None):
         super().__init__(config, train_set, objective)

@@ -280,7 +280,43 @@ class GBDT:
         pallas_shape_key = pallas_config_key(
             int(np.dtype(_kernel_dtype).itemsize), _kernel_bins,
             slots, cols_pad, 5 if config.tpu_hist_hilo else 3)
+        # ---- residency (ROADMAP item 3, docs/TPU-Performance.md): decide
+        #      BEFORE any device placement whether the binned code matrix
+        #      is HBM-resident ("device") or streams from host shards
+        #      ("stream", ops/stream.py). "auto" streams iff the analytic
+        #      device-residency estimate exceeds the per-device HBM budget
+        #      (tpu_hbm_budget_bytes / LGBM_TPU_HBM_BUDGET / reported
+        #      capacity) — the PR-6 pre-flight's WARN upgraded to an
+        #      automatic fallback. Uses a provisional Npad (the pallas
+        #      chunk shrink below can only lower it, and stream forces the
+        #      xla kernel anyway). ----
+        self.residency = self._resolve_residency(
+            config, per_target=per_target, chunk=chunk,
+            cols_pad=cols_pad, code_itemsize=int(
+                np.dtype(_kernel_dtype).itemsize),
+            bins_pad=Bpad, bins_hist=_kernel_bins, slots=slots,
+            num_leaves=num_leaves, num_models=K)
+        if self.residency == "stream" and config.tpu_row_compact:
+            # normalize the config to its EFFECTIVE semantics (stream runs
+            # full streaming passes — no compaction) so the checkpoint
+            # fingerprint covers what actually trains: a streamed run then
+            # resumes into tpu_residency=device + tpu_row_compact=false
+            # with bit-identical continued training
+            config = config.replace(tpu_row_compact=False)
+            self.config = config
+
         hist_kernel = config.tpu_hist_kernel
+        if self.residency == "stream":
+            # the streamed shard pass is the XLA one-hot matmul: the pallas
+            # kernel only serves COMPACTED passes, and stream mode runs
+            # full streaming passes by construction (row compaction needs
+            # the packed row matrix device-resident — the very thing
+            # streaming removes)
+            if hist_kernel in ("pallas", "mixed"):
+                Log.warning("tpu_residency=stream streams full histogram "
+                            "passes through the xla kernel; overriding "
+                            "tpu_hist_kernel=%s", hist_kernel)
+            hist_kernel = "xla"
         if hist_kernel == "auto":
             # Round-5 pass-level shootout (exp/kern_bench_r5.py): pallas-512
             # wins COMPACTED passes (18.0 vs 22.1 ms at 25% active) while
@@ -363,7 +399,49 @@ class GBDT:
         # same mesh/padding reuses the same on-device buffers — the binned
         # dataset lives on the mesh once, not once per booster.
         col_pad = (0, cols_pad - Xb.shape[1])
-        if self._block_counts is not None:
+        self._stream_store = None
+        self._stream = None
+        self._streamed_grower = None
+        self._stream_fns = None
+        if self.residency == "stream":
+            # out-of-core: the padded (possibly bundled) code matrix is cut
+            # into fixed-size host shards, packed to the tightest byte
+            # layout the bin range allows (u4 at <16 bins — the
+            # "compressed bin codes" of arXiv 1806.11248), and NEVER
+            # device_put whole. The shard size divides the padded
+            # per-device rows exactly, so Npad, every chunk boundary, and
+            # the bagging RNG shapes are identical to device residency —
+            # the bit-identity contract (tests/test_stream.py).
+            from ..ops.stream import (HostShardStore, ShardPrefetcher,
+                                      resolve_shard_rows)
+            from ..ops.histogram import code_mode_for
+            shard_devs = (self.pctx.num_devices
+                          if self.pctx.mesh is not None
+                          and self.pctx.strategy in ("data", "voting")
+                          else 1)
+            local_rd = resolve_shard_rows(Npad // shard_devs, chunk,
+                                          config.tpu_stream_shard_rows)
+            _max_code = (bundle_plan.max_bundle_bins
+                         if bundle_plan is not None
+                         else train_set.max_num_bin)
+            # the store pads per block at pack time — no full padded copy
+            # of a matrix that by definition outgrows memory budgets
+            self._stream_store = HostShardStore(
+                Xb, n_rows_padded=Npad, num_cols=cols_pad,
+                local_shard_rows=local_rd, n_devices=shard_devs,
+                code_mode=code_mode_for(int(_max_code), Xb.dtype))
+            self._stream = ShardPrefetcher(
+                self._stream_store, lambda a: self._put(a, "rows0"))
+            self.Xb = None
+            sd = self._stream_store.describe()
+            Log.info(
+                "tpu_residency=stream: codes in %d host shards x %d rows "
+                "(%s-packed, %.1f MB/shard, %.2f GB total); H2D double-"
+                "buffered through the wave loop, row compaction off "
+                "(full streaming passes)", sd["n_shards"],
+                sd["shard_rows"], sd["code_mode"],
+                sd["shard_bytes"] / (1 << 20), sd["total_bytes"] / (1 << 30))
+        elif self._block_counts is not None:
             bp = Npad // len(self._block_counts)
             self.Xb = self._put_rows0_local(
                 np.pad(Xb, ((0, bp - Xb.shape[0]), col_pad)), Npad)
@@ -455,7 +533,12 @@ class GBDT:
             min_data_in_leaf=float(config.min_data_in_leaf),
             min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
             min_gain_to_split=config.min_gain_to_split,
-            row_compact=config.tpu_row_compact,
+            # stream mode runs full streaming passes: compaction gathers
+            # rows from a device-resident packed matrix — the very
+            # allocation streaming removes. Bit-identity is therefore
+            # against device residency with tpu_row_compact=false.
+            row_compact=(config.tpu_row_compact
+                         and self.residency != "stream"),
             incremental_partition=config.tpu_incremental_partition,
             compact_frac=config.tpu_compact_frac,
             hist_kernel=hist_kernel,
@@ -475,6 +558,17 @@ class GBDT:
             num_bundles=(self._num_bundles_padded
                          if self.pctx.strategy == "feature" else 0),
             bundle_col=None if self.bundle is None else self.bundle.col)
+        if self.residency == "stream":
+            from ..grower import StreamedGrower
+            self._streamed_grower = StreamedGrower(
+                self.spec, self.pctx, self.comm,
+                n_rows_padded=Npad,
+                local_shard_rows=self._stream_store.local_shard_rows,
+                n_shards=self._stream_store.n_shards,
+                num_cols=cols_pad, code_mode=self._stream_store.code_mode,
+                num_bins=self.num_bins, missing_code=self.missing_code,
+                default_bin=self.default_bin, is_cat=self.is_cat,
+                bundle=self.bundle)
 
         # feature_fraction: number of features used per tree
         self.n_feature_sample = max(1, int(round(config.feature_fraction * F)))
@@ -562,6 +656,16 @@ class GBDT:
                 "falling back to tree_batch=1", tb,
                 config.boosting_normalized)
             tb = 1
+        if tb > 1 and self.residency == "stream":
+            # pinned in tests/test_stream.py: the shard loop is driven by
+            # the host per wave — fusing K iterations under one lax.scan
+            # would trap the H2D transfers inside a traced body, which is
+            # exactly what tpu-lint R009 forbids
+            Log.warning(
+                "tree_batch=%d is not supported with tpu_residency=stream "
+                "(the shard prefetch loop is host-driven); falling back "
+                "to tree_batch=1", tb)
+            tb = 1
         if (tb > 1 and self.average_output
                 and config.nan_policy in ("raise", "skip_iter")):
             # RF's running-average score weights by the device iteration
@@ -584,14 +688,22 @@ class GBDT:
         # per-booster facts the next perf session reads first
         reg = obs.get_registry()
         reg.counter(f"booster.kernel.{hist_kernel}").inc()
+        reg.counter(f"booster.residency.{self.residency}").inc()
         reg.gauge("booster.tree_batch").set(tb)
         reg.gauge("booster.wave_size").set(self.spec.wave_size)
         reg.gauge("booster.hist_slots").set(self.spec.hist_slots)
+        if self._stream_store is not None:
+            reg.gauge("stream.n_shards").set(self._stream_store.n_shards)
+            reg.gauge("stream.shard_bytes").set(
+                self._stream_store.shard_bytes)
         obs.event("booster_init", kernel=hist_kernel, tree_batch=tb,
                   rows=int(N), features=int(F), num_leaves=int(num_leaves),
                   strategy=self.pctx.strategy, nan_policy=self.nan_policy,
                   mesh_axis=self.pctx.axis_kind,
-                  n_devices=self.pctx.num_devices)
+                  n_devices=self.pctx.num_devices,
+                  residency=self.residency)
+        if self._stream_store is not None:
+            obs.event("stream_init", **self._stream_store.describe())
         # MULTICHIP story: the resolved mesh (device count + which dataset
         # axis it shards — the tree_learner=auto outcome) and the analytic
         # per-wave collective payload estimates (parallel/comm.py
@@ -612,6 +724,107 @@ class GBDT:
             obs.event("comm_cost", strategy=self.pctx.strategy, **comm_bytes)
 
     # ------------------------------------------------------------------ setup
+
+    # out-of-core streaming capability (tpu_residency=stream): the whole
+    # per-iteration pipeline must be drivable through the host-side shard
+    # loop; DART opts out (host-side drop-set selection reads the resident
+    # code matrix per tree via _contrib_fn)
+    supports_stream = True
+
+    def _stream_support(self, config) -> Tuple[bool, str]:
+        """(supported, why-not) for tpu_residency=stream under this
+        booster's strategy/topology — consulted by the residency
+        resolution (forced stream fails loudly; auto never picks an
+        unsupported mode)."""
+        if not self.supports_stream:
+            return False, (f"boosting={config.boosting_normalized} keeps "
+                           f"host-side per-tree state that reads the "
+                           f"resident code matrix")
+        if self.pctx.strategy == "feature":
+            return False, ("tree_learner=feature replicates rows and "
+                           "slices columns at trace time; stream shards "
+                           "rows (use data/voting)")
+        if self.pctx.multi_process:
+            return False, ("multi-host execution streams per-process "
+                           "shards is not wired yet (single-process "
+                           "meshes only)")
+        if config.is_pre_partition:
+            return False, "is_pre_partition holds per-process row blocks"
+        return True, ""
+
+    def _resolve_residency(self, config, *, per_target: int, chunk: int,
+                           cols_pad: int, code_itemsize: int,
+                           bins_pad: int, bins_hist: int, slots: int,
+                           num_leaves: int, num_models: int) -> str:
+        """Resolve ``tpu_residency`` before any device placement.
+
+        ``auto`` compares an analytic DEVICE-residency estimate
+        (observability/memory.py estimate_wave_residency, the PR-6
+        pre-flight model at provisional padding) against the per-device
+        HBM budget and falls back to ``stream`` when it does not fit —
+        the warning the pre-flight used to stop at, turned into the fix.
+        The decision estimate sizes the histogram cache at full width
+        (conservative under data-parallel's block-sharded cache: an
+        overestimate can only stream earlier, never OOM later)."""
+        from ..observability.memory import (estimate_wave_residency,
+                                            hbm_budget_bytes)
+        requested = config.tpu_residency
+        if requested == "device":
+            return "device"
+        supported, why = self._stream_support(config)
+        if requested == "stream":
+            if not supported:
+                Log.fatal("tpu_residency=stream is not supported here: %s",
+                          why)
+            return "stream"
+        # auto: estimate full-N device residency per device
+        budget = hbm_budget_bytes(config)
+        if budget is None:
+            return "device"
+        rows = _round_up(per_target, chunk)   # padded PER-DEVICE rows
+        if config.tpu_hist_f64:
+            channels, chb = 3, 4
+        elif config.tpu_hist_hilo:
+            channels, chb = 5, 2
+        else:
+            channels, chb = 3, 2
+        packed_row_bytes = 0
+        if config.tpu_row_compact:
+            from ..ops.histogram import code_bytes_total
+            mode = "u16" if code_itemsize == 2 else "u8"
+            packed_row_bytes = (code_bytes_total(cols_pad, mode)
+                                + channels * chb)
+        est = estimate_wave_residency(
+            rows=rows, cols=cols_pad, code_itemsize=code_itemsize,
+            num_models=num_models, num_leaves=num_leaves,
+            hist_cols=cols_pad, hist_bins=bins_hist, cache_cols=cols_pad,
+            cache_bins=bins_hist, num_bins_padded=bins_pad, slots=slots,
+            chunk_rows=chunk, channels=channels, channel_bytes=chb,
+            packed_row_bytes=packed_row_bytes,
+            row_compact=config.tpu_row_compact,
+            incremental=config.tpu_incremental_partition,
+            bagging=(config.bagging_freq > 0
+                     and config.bagging_fraction < 1.0),
+            tree_batch=max(1, config.tree_batch))
+        if est["total_bytes"] <= budget:
+            return "device"
+        gb = 1 << 30
+        if not supported:
+            Log.warning(
+                "HBM pre-flight: estimated device residency %.3g GB "
+                "exceeds the %.3g GB budget but tpu_residency=stream is "
+                "unavailable (%s) — staying device-resident; expect an "
+                "OOM at first dispatch", est["total_bytes"] / gb,
+                budget / gb, why)
+            return "device"
+        Log.warning(
+            "HBM pre-flight: estimated device residency %.3g GB exceeds "
+            "the %.3g GB per-device budget — auto-selecting "
+            "tpu_residency=stream: the binned codes stay in host-resident "
+            "packed shards and stream H2D double-buffered through the "
+            "wave loop (docs/TPU-Performance.md \"Out-of-core streaming\")",
+            est["total_bytes"] / gb, budget / gb)
+        return "stream"
 
     def _real_rows(self):
         """Index of real (non-padding) rows in the padded device layout, in
@@ -723,6 +936,60 @@ class GBDT:
         """Hook: base adds; RF maintains a running average (rf.hpp:117-121)."""
         return old_score_k + contrib
 
+    # Per-tree math blocks shared VERBATIM by the resident ``step_body``
+    # and the streamed step legs (``_make_stream_fns``) — like the grower's
+    # ``_apply_wave_splits``, each has exactly one home so the two
+    # residency modes cannot drift apart (the bit-identity contract of
+    # tests/test_stream.py). All three are traced inside whichever jit
+    # calls them.
+
+    def _feature_mask(self, fkey, k):
+        """Per-model feature_fraction mask (serial_tree_learner.cpp:240)."""
+        if not self.use_feature_fraction:
+            return self.feature_ok_base
+        fk = jax.random.fold_in(fkey, k)
+        noise = jax.random.uniform(fk, (self.spec.num_features,))
+        # padding features must not consume sample slots
+        noise = jnp.where(self.feature_ok_base, noise, -1.0)
+        _, top_idx = jax.lax.top_k(noise, self.n_feature_sample)
+        fmask = jnp.zeros(self.spec.num_features, bool).at[top_idx].set(True)
+        return fmask & self.feature_ok_base
+
+    def _shrink_transform_flag(self, tree, shrinkage):
+        """Shrinkage + output transform + (under nan_policy) the leaf
+        non-finite flag and clip. Returns ``(tree, bad_leaf_or_None)``.
+        Reference Tree::Shrinkage scales internal_value_ too
+        (tree.h:137-142) — TreeSHAP reads node means from it."""
+        tree = tree._replace(
+            leaf_value=tree.leaf_value * shrinkage,
+            internal_value=tree.internal_value * shrinkage)
+        tree = self._tree_output_transform(tree)
+        if self.nan_policy == "none":
+            return tree, None
+        from ..robustness.numeric import clip_nonfinite, nonfinite_flag
+        bl = nonfinite_flag(tree.leaf_value)
+        if self.nan_policy == "clip":
+            tree = tree._replace(
+                leaf_value=clip_nonfinite(tree.leaf_value),
+                internal_value=clip_nonfinite(tree.internal_value))
+        return tree, bl
+
+    def _tree_score_updates(self, score_k, valid_k, valid_Xb, tree,
+                            leaf_ids, it):
+        """Apply one (shrunk) tree to the train score and every valid
+        score: ``(new_score_k, [new_valid_k...])``."""
+        new_score_k = self._score_update(
+            score_k, table_lookup(leaf_ids, tree.leaf_value), it)
+        new_valid_k = []
+        for vi in range(len(valid_Xb)):
+            vleaf = leaves_from_binned(
+                tree, valid_Xb[vi], self.num_bins, self.missing_code,
+                self.default_bin,
+                use_categorical=self.spec.use_categorical)
+            new_valid_k.append(self._score_update(
+                valid_k[vi], table_lookup(vleaf, tree.leaf_value), it))
+        return new_score_k, new_valid_k
+
     # device-array attributes captured by the training step; under
     # multi-host they must travel as jit ARGUMENTS (closing over arrays
     # spanning non-addressable devices is rejected), so the step rebinds
@@ -822,43 +1089,21 @@ class GBDT:
             nleaves = []
             new_scores = []
             new_valid = [list(vs) for vs in valid_scores] if valid_scores else []
+            vXb = tuple(vs.Xb for vs in self.valid_sets)
             for k in range(K):
-                if self.use_feature_fraction:
-                    fk = jax.random.fold_in(fkey, k)
-                    noise = jax.random.uniform(fk, (spec.num_features,))
-                    # padding features must not consume sample slots
-                    noise = jnp.where(self.feature_ok_base, noise, -1.0)
-                    _, top_idx = jax.lax.top_k(noise, self.n_feature_sample)
-                    fmask = jnp.zeros(spec.num_features, bool).at[top_idx].set(True)
-                    fmask = fmask & self.feature_ok_base
-                else:
-                    fmask = self.feature_ok_base
+                fmask = self._feature_mask(fkey, k)
                 tree, leaf_ids = grow(
                     self.Xb, g[k] * mask, h[k] * mask, mask, fmask, self.is_cat,
                     self.num_bins, self.missing_code, self.default_bin)
-                # reference Tree::Shrinkage scales internal_value_ too
-                # (tree.h:137-142) — TreeSHAP reads node means from it
-                tree = tree._replace(
-                    leaf_value=tree.leaf_value * shrinkage,
-                    internal_value=tree.internal_value * shrinkage)
-                tree = self._tree_output_transform(tree)
-                if nan_policy != "none":
-                    bl = nonfinite_flag(tree.leaf_value)
+                tree, bl = self._shrink_transform_flag(tree, shrinkage)
+                if bl is not None:
                     bad_leaf = bl if bad_leaf is None else (bad_leaf | bl)
-                    if nan_policy == "clip":
-                        tree = tree._replace(
-                            leaf_value=clip_nonfinite(tree.leaf_value),
-                            internal_value=clip_nonfinite(tree.internal_value))
-                new_scores.append(self._score_update(
-                    score[k], table_lookup(leaf_ids, tree.leaf_value), it))
-                for vi, vs in enumerate(self.valid_sets):
-                    vleaf = leaves_from_binned(
-                        tree, vs.Xb, self.num_bins, self.missing_code,
-                        self.default_bin,
-                        use_categorical=spec.use_categorical)
-                    new_valid[vi][k] = self._score_update(
-                        new_valid[vi][k], table_lookup(vleaf, tree.leaf_value),
-                        it)
+                new_score_k, new_valid_k = self._tree_score_updates(
+                    score[k], [new_valid[vi][k] for vi in range(len(vXb))],
+                    vXb, tree, leaf_ids, it)
+                new_scores.append(new_score_k)
+                for vi in range(len(vXb)):
+                    new_valid[vi][k] = new_valid_k[vi]
                 trees.append(tree)
                 nleaves.append(tree.num_leaves)
             out_score = jnp.stack(new_scores)
@@ -1032,8 +1277,12 @@ class GBDT:
         # value is read (the recompile-free steady state is preserved)
         with TIMERS("train_step"), obs.span("tree_batch", k=1), \
                 obs.span("iteration", iteration=self.iter_):
-            score, out_valid = self._run_step(self.score,
-                                              self._step_shrinkage())
+            if self.residency == "stream":
+                score, out_valid = self._run_streamed_step(
+                    self._step_shrinkage())
+            else:
+                score, out_valid = self._run_step(self.score,
+                                                  self._step_shrinkage())
             self.score = score
             for vi, vs in enumerate(self.valid_sets):
                 vs.score = jnp.stack(out_valid[vi])
@@ -1041,6 +1290,162 @@ class GBDT:
     def _step_shrinkage(self) -> float:
         """Hook: per-tree shrinkage (RF overrides to 1.0, rf.hpp:44-45)."""
         return self.config.learning_rate
+
+    # ------------------------------------- streamed step (tpu_residency=stream)
+
+    def _make_stream_fns(self) -> Dict:
+        """Jitted legs of the streamed training step. The resident step is
+        ONE jit; in stream mode the shard loop is host-driven, so the step
+        splits at the grower boundary into ``pre`` (RNG fold + gradients +
+        non-finite detection + bagging), ``prep`` (per-model masked grads +
+        feature_fraction mask), ``shrink`` (shrinkage + output transform +
+        leaf flag), and ``apply`` (train/valid score updates, nan gating,
+        device iteration counter). Each leg traces through the SAME hook
+        methods ``step_body`` uses, in the same order, so a streamed
+        iteration is bit-identical to a resident one. All shapes are fixed
+        — the whole set compiles once per booster (RecompileGuard-pinned in
+        tests/test_stream.py)."""
+        spec = self.spec
+        K = self.num_models
+        nan_policy = self.nan_policy
+        if nan_policy != "none":
+            from ..robustness.numeric import clip_nonfinite, nonfinite_flag
+
+        def make_pre(custom: bool):
+            def pre_body(score, bag_mask, key, it, *grads):
+                key = jax.random.fold_in(key, it)
+                if custom:
+                    g, h = grads
+                else:
+                    g, h = self._gradients(score)
+                bad = ()
+                if nan_policy != "none":
+                    bad_g, bad_h = nonfinite_flag(g), nonfinite_flag(h)
+                    if nan_policy == "clip":
+                        g, h = clip_nonfinite(g), clip_nonfinite(h)
+                    bad = (bad_g, bad_h)
+                bkey, fkey = jax.random.split(jax.random.fold_in(key, 0))
+                mask, g, h = self._sampling(g, h, bag_mask, bkey, it)
+                return (g, h, mask, fkey) + bad
+            return pre_body
+
+        def prep_body(g, h, mask, fkey, k):
+            return g[k] * mask, h[k] * mask, self._feature_mask(fkey, k)
+
+        def shrink_body(tree, shrinkage):
+            return self._shrink_transform_flag(tree, shrinkage)
+
+        def apply_body(score, valid_scores, valid_Xb, bag_mask, mask,
+                       trees, leaf_ids, it, flags):
+            new_scores = []
+            new_valid = [list(vs) for vs in valid_scores] if valid_scores \
+                else []
+            for k in range(K):
+                new_score_k, new_valid_k = self._tree_score_updates(
+                    score[k],
+                    [new_valid[vi][k] for vi in range(len(valid_Xb))],
+                    valid_Xb, trees[k], leaf_ids[k], it)
+                new_scores.append(new_score_k)
+                for vi in range(len(valid_Xb)):
+                    new_valid[vi][k] = new_valid_k[vi]
+            out_score = jnp.stack(new_scores)
+            out_valid = tuple(tuple(v) for v in new_valid)
+            nl = jnp.stack([t.num_leaves for t in trees])
+            if nan_policy == "none":
+                return out_score, out_valid, mask, nl, it + 1
+            bad_g, bad_h, bad_leafs = flags
+            bad_leaf = bad_leafs[0]
+            for bl in bad_leafs[1:]:
+                bad_leaf = bad_leaf | bl
+            nf = jnp.stack([bad_g, bad_h, bad_leaf])
+            if nan_policy in ("raise", "skip_iter"):
+                # hardware-gate every output on the poison flag, exactly
+                # like the resident step: a poisoned iteration leaves
+                # scores/masks BIT-identical to their pre-step values
+                bad = jnp.any(nf)
+                out_score = jnp.where(bad, score, out_score)
+                out_valid = tuple(
+                    tuple(jnp.where(bad, old_k, new_k)
+                          for old_k, new_k in zip(old_vs, new_vs))
+                    for old_vs, new_vs in zip(valid_scores, out_valid))
+                mask = jnp.where(bad, bag_mask, mask)
+            return out_score, out_valid, mask, nl, it + 1, nf
+
+        # donate the carried score/valid-scores (and, under bagging, the
+        # previous mask) into apply — the streamed twin of _make_step's
+        # donate_argnums, with the same rebind-immediately discipline
+        donate = () if self.pctx.devices[0].platform == "cpu" else \
+            ((0, 1, 3) if self.bagging_on else (0, 1))
+        return dict(pre=jax.jit(make_pre(False)),
+                    pre_custom=jax.jit(make_pre(True)),
+                    prep=jax.jit(prep_body),
+                    shrink=jax.jit(shrink_body),
+                    apply=jax.jit(apply_body, donate_argnums=donate))
+
+    def _run_streamed_step(self, shrinkage: float, custom_gh=None):
+        """One streamed boosting iteration: pre -> per-model (prep ->
+        StreamedGrower.grow over the shard prefetcher -> shrink) -> apply,
+        with the SAME host bookkeeping contract as ``_run_step`` (models
+        appended, counters advanced, then the nan policy fetch)."""
+        if self._stream_fns is None:
+            self._stream_fns = self._make_stream_fns()
+        fns = self._stream_fns
+        if self._iter_dev is None:    # first step / post-rollback resync
+            self._iter_dev = jnp.asarray(self.iter_, jnp.int32)
+        if self._shrink_cache[0] != shrinkage:
+            self._shrink_cache = (shrinkage,
+                                  jnp.asarray(shrinkage, jnp.float32))
+        valid_scores = tuple(tuple(vs.score[k] for k in range(self.num_models))
+                             for vs in self.valid_sets)
+        valid_Xb = tuple(vs.Xb for vs in self.valid_sets)
+        if custom_gh is not None:
+            outs = fns["pre_custom"](self.score, self.bag_mask,
+                                     self._rng_key, self._iter_dev,
+                                     *custom_gh)
+        else:
+            outs = fns["pre"](self.score, self.bag_mask, self._rng_key,
+                              self._iter_dev)
+        if self.nan_policy != "none":
+            g, h, mask, fkey, bad_g, bad_h = outs
+        else:
+            g, h, mask, fkey = outs
+            bad_g = bad_h = None
+        trees, leaf_ids, bad_leafs = [], [], []
+        for k in range(self.num_models):
+            gk, hk, fmask = fns["prep"](g, h, mask, fkey, np.int32(k))
+            tree_raw, lid = self._streamed_grower.grow(
+                self._stream, gk, hk, mask, fmask)
+            tree, bl = fns["shrink"](tree_raw, self._shrink_cache[1])
+            if bl is not None:
+                bad_leafs.append(bl)
+            trees.append(tree)
+            leaf_ids.append(lid)
+        flags = ((bad_g, bad_h, tuple(bad_leafs))
+                 if self.nan_policy != "none" else None)
+        outs = fns["apply"](self.score, valid_scores, valid_Xb,
+                            self.bag_mask, mask, tuple(trees),
+                            tuple(leaf_ids), self._iter_dev, flags)
+        nf = None
+        if self.nan_policy != "none":
+            score, out_valid, self.bag_mask, nl, self._iter_dev, nf = outs
+        else:
+            score, out_valid, self.bag_mask, nl, self._iter_dev = outs
+        self.models.append(list(trees))
+        self._num_leaves_dev.append(nl)
+        self.iter_ += 1
+        self.mutations_ = getattr(self, "mutations_", 0) + 1
+        if nf is not None:
+            try:
+                self._apply_nan_policy(nf)
+            except Exception:
+                # the pre-step score/valid buffers were DONATED to apply —
+                # rebind the (gated, bit-identical) outputs before
+                # propagating, exactly like the resident path
+                self.score = score
+                for vi, vs in enumerate(self.valid_sets):
+                    vs.score = jnp.stack(out_valid[vi])
+                raise
+        return score, out_valid
 
     # --------------------------------------------- fused multi-tree dispatch
 
@@ -1054,6 +1459,13 @@ class GBDT:
         callbacks happen at the caller's batch boundaries (engine.py)."""
         if n <= 1:
             return self.train_one_iter()
+        if self.residency == "stream":
+            # tree_batch is forced to 1 at construction (the shard loop is
+            # host-driven); a direct caller still gets the equivalent
+            # semantics, unfused
+            for _ in range(n):
+                self.train_one_iter()
+            return
         base_iter = self.iter_
         with TIMERS("train_step"), obs.span("tree_batch", k=n):
             self._run_fused_batch(n)
@@ -1190,9 +1602,14 @@ class GBDT:
             h = np.zeros((K, Npad), np.float32)
             g[:, :N] = np.asarray(grad, np.float32).reshape(K, N)
             h[:, :N] = np.asarray(hess, np.float32).reshape(K, N)
-            score, out_valid = self._run_step(
-                self.score, self.config.learning_rate,
-                custom_gh=(self._put(g, "rows1"), self._put(h, "rows1")))
+            custom_gh = (self._put(g, "rows1"), self._put(h, "rows1"))
+            if self.residency == "stream":
+                score, out_valid = self._run_streamed_step(
+                    self.config.learning_rate, custom_gh=custom_gh)
+            else:
+                score, out_valid = self._run_step(
+                    self.score, self.config.learning_rate,
+                    custom_gh=custom_gh)
             self.score = score
             for vi, vs in enumerate(self.valid_sets):
                 vs.score = jnp.stack(out_valid[vi])
@@ -1217,6 +1634,14 @@ class GBDT:
         if self.average_output:
             Log.fatal("rollback_one_iter is not supported for rf boosting "
                       "(scores are running averages, not additive)")
+        if self.residency == "stream":
+            # subtracting a tree's contribution replays leaves_from_binned
+            # over the full resident code matrix — which stream mode never
+            # materializes. The nan_policy=raise path does not need it
+            # (streamed steps gate their outputs before committing).
+            Log.fatal("rollback_one_iter is not supported with "
+                      "tpu_residency=stream (no resident code matrix to "
+                      "replay leaf assignments from)")
         if not self.models:
             return
         trees = self.models.pop()
@@ -1293,6 +1718,7 @@ class GBDT:
             self._step_fn = None
             self._custom_step_fn = None
             self._batch_step_fns = {}
+            self._stream_fns = None
 
     def _pop_last_iteration(self) -> None:
         """Drop the last appended iteration's bookkeeping WITHOUT score

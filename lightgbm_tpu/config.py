@@ -322,6 +322,27 @@ class Config:
     # legacy per-wave argsort rebuild (bit-identical — the A/B + parity pin,
     # tests/test_incremental_partition.py)
     tpu_incremental_partition: bool = True
+    # --- out-of-core streaming (ops/stream.py, docs/TPU-Performance.md) ----
+    # where the binned code matrix LIVES during training:
+    #   device — fully HBM-resident (the historical behavior)
+    #   stream — host-resident packed row shards, double-buffered H2D
+    #            through the wave loop; gradients/scores/partition state
+    #            stay on device. Bit-identical to device residency (which
+    #            it forces tpu_row_compact=false to match), unlocks
+    #            datasets far beyond HBM.
+    #   auto   — stream iff the analytic HBM pre-flight estimate exceeds
+    #            the per-device budget (tpu_hbm_budget_bytes or the
+    #            reported device capacity), else device.
+    tpu_residency: str = "auto"
+    # rows per host shard PER DEVICE (rounded to a divisor of the padded
+    # per-device row count that is a multiple of tpu_hist_chunk — shard
+    # size never changes the math, so any value resumes any checkpoint);
+    # 0 = auto (~8 shards)
+    tpu_stream_shard_rows: int = 0
+    # artificial per-device HBM budget in bytes for the residency auto-
+    # decision and the engine.train budget line; 0 = use the capacity the
+    # backend reports (env LGBM_TPU_HBM_BUDGET overrides both)
+    tpu_hbm_budget_bytes: int = 0
     # histogram kernel: "auto" resolves to "mixed" (XLA streaming passes +
     # pallas-512 compacted passes — the round-5 pass-level measured best,
     # 18.0 vs 22.1 ms at 25% active) on a real TPU whose on-chip gate has
@@ -444,6 +465,15 @@ class Config:
         if self.tpu_hist_kernel not in ("auto", "xla", "pallas", "mixed"):
             Log.fatal("Unknown tpu_hist_kernel %s (auto|xla|pallas|mixed)",
                       self.tpu_hist_kernel)
+        if self.tpu_residency not in ("auto", "device", "stream"):
+            Log.fatal("Unknown tpu_residency %s (auto|device|stream)",
+                      self.tpu_residency)
+        if self.tpu_stream_shard_rows < 0:
+            Log.fatal("tpu_stream_shard_rows must be >= 0 (0 = auto), got %d",
+                      self.tpu_stream_shard_rows)
+        if self.tpu_hbm_budget_bytes < 0:
+            Log.fatal("tpu_hbm_budget_bytes must be >= 0 (0 = device "
+                      "capacity), got %d", self.tpu_hbm_budget_bytes)
         if not 0.0 < self.tpu_compact_frac <= 1.0:
             # <=0 silently disables compaction; >1 forces the argsort+gather
             # path on every pass (n_active < frac*N is always true)

@@ -103,7 +103,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # plus a warning when the estimate exceeds device_memory() capacity
     from .observability import memory as obs_memory
     try:
-        obs_memory.log_budget(obs_memory.hbm_preflight(booster._gbdt))
+        # residency-aware: a booster that auto-fell-back to
+        # tpu_residency=stream reports per-shard (not full-N) codes and
+        # only warns when even the streamed state misses the budget
+        obs_memory.log_budget(obs_memory.hbm_preflight(booster._gbdt),
+                              budget=obs_memory.hbm_budget_bytes(config))
     except Exception as e:                                   # noqa: BLE001
         Log.debug("HBM pre-flight estimate failed: %s: %s",
                   type(e).__name__, e)

@@ -45,6 +45,7 @@ import numpy as np
 
 from .ops.histogram import build_histograms, root_sums, table_lookup
 from .ops.split_finder import SplitCandidates, leaf_output
+from .robustness import allowed_host_sync
 
 NEG_INF = -jnp.inf
 
@@ -274,6 +275,208 @@ def _empty_cand(L: int, B: int) -> SplitCandidates:
         is_cat=jnp.zeros(L + 1, bool),
         cat_mask=jnp.zeros((L + 1, B), bool),
     )
+
+
+def _apply_wave_splits(state: GrowState, new_hist: jnp.ndarray,
+                       leaf_of_slot: jnp.ndarray, bm, spec: "GrowerSpec",
+                       comm, scan_bundle: Optional[BundleDecode],
+                       num_bins: jnp.ndarray, missing_code: jnp.ndarray,
+                       default_bin: jnp.ndarray):
+    """Steps 3-6 of one wave — cache write + sibling subtraction, split
+    scan, split choice, tree/leaf-state apply — plus the [L+1, 6] routing
+    table and categorical left-set mask the per-row routing pass consumes.
+
+    Shared VERBATIM by the resident wave body (``grow_tree``) and the
+    streamed ``wave_update`` (``StreamedGrower``): residency is a transport
+    decision, so the split math must have exactly one home or the two
+    modes drift apart bit by bit. ``new_hist`` arrives post-``reduce_hist``
+    (and post-early-unbundle where that applies); ``scan_bundle`` is the
+    EFB decode table ONLY when the split scan itself must unpack (serial /
+    bundled-block layouts), else None.
+
+    Returns ``(state', table, map_mask, p, q, n_apply)`` with ``state'``
+    carrying every field EXCEPT the per-row ones (leaf_id and the
+    incremental partition), which the caller owns; ``p``/``q`` are the
+    per-slot split/new-right leaves the resident partition maintenance
+    keys on.
+    """
+    L = spec.num_leaves
+    M = L - 1
+    S = spec.hist_slots
+    B = spec.num_bins_padded
+    leaf_iota = jnp.arange(L + 1, dtype=jnp.int32)
+
+    # ---- 3. cache write + sibling by subtraction -----------------------
+    slot_valid = leaf_of_slot < L
+    sibs = state.sib_leaf[leaf_of_slot]                       # [S]
+    parent_rows = state.parent_cache[leaf_of_slot]            # [S]
+    parent_hist = state.hist[parent_rows]                     # [S, F, B, 3]
+    sib_hist = parent_hist - new_hist
+    hist = state.hist
+    hist = hist.at[jnp.where(slot_valid, leaf_of_slot, L)].set(new_hist)
+    hist = hist.at[jnp.where(slot_valid, sibs, L)].set(sib_hist)
+
+    # ---- 4. split scan for the 2S touched leaves -----------------------
+    scan_leaves = jnp.concatenate([leaf_of_slot, jnp.where(slot_valid, sibs, L)])
+    scan_hist = jnp.concatenate([new_hist, sib_hist], axis=0)  # [2S, F, B, 3]
+    if scan_bundle is not None:
+        scan_hist = _unpack_bundled(
+            scan_hist, scan_bundle, state.sum_g[scan_leaves],
+            state.sum_h[scan_leaves], state.cnt[scan_leaves], default_bin)
+    # candidate features are GLOBAL indices; under feature/data
+    # parallelism this ends in an all-gather argmax across devices
+    # (reference SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207)
+    cand_new = comm.find_splits(
+        scan_hist,
+        state.sum_g[scan_leaves], state.sum_h[scan_leaves], state.cnt[scan_leaves],
+        bm, spec)
+    cand = SplitCandidates(*[
+        old.at[scan_leaves].set(new) for old, new in zip(state.cand, cand_new)])
+    cand = cand._replace(gain=cand.gain.at[L].set(NEG_INF))  # keep scratch row inert
+    needs_hist = jnp.zeros_like(state.needs_hist)
+
+    # ---- 5. choose splits to apply this wave ---------------------------
+    active = leaf_iota < state.num_leaves_cur
+    depth_ok = (spec.max_depth <= 0) | (state.leaf_depth < spec.max_depth)
+    gains = jnp.where(active & depth_ok & jnp.isfinite(cand.gain), cand.gain, NEG_INF)
+    top_gain, top_leaf = jax.lax.top_k(gains, S)
+    budget = L - state.num_leaves_cur
+    cap = min(spec.wave_size, S) if spec.wave_size > 0 else S
+    srank = jnp.arange(S, dtype=jnp.int32)
+    apply = jnp.isfinite(top_gain) & (srank < budget) & (srank < cap)
+    n_apply = jnp.sum(apply.astype(jnp.int32))
+
+    # ---- 6. apply: tree arrays + leaf state ----------------------------
+    p = jnp.where(apply, top_leaf, L)                         # split leaf (L=dummy)
+    nid = jnp.where(apply, state.num_leaves_cur - 1 + srank, M)  # new internal node
+    q = jnp.where(apply, state.num_leaves_cur + srank, L)     # new right leaf
+
+    lg = cand.left_g[p]
+    lh = cand.left_h[p]
+    lc = cand.left_c[p]
+    pg, ph, pc = state.sum_g[p], state.sum_h[p], state.cnt[p]
+    rg_, rh_, rc_ = pg - lg, ph - lh, pc - lc
+
+    t = state.tree
+    t = t._replace(
+        split_feature=t.split_feature.at[nid].set(cand.feature[p]),
+        threshold_bin=t.threshold_bin.at[nid].set(cand.threshold[p]),
+        default_left=t.default_left.at[nid].set(cand.default_left[p]),
+        is_cat=t.is_cat.at[nid].set(cand.is_cat[p]),
+        cat_mask=t.cat_mask.at[nid].set(cand.cat_mask[p]),
+        split_gain=t.split_gain.at[nid].set(cand.gain[p]),
+        internal_value=t.internal_value.at[nid].set(
+            leaf_output(pg, ph, spec.lambda_l1, spec.lambda_l2)),
+        internal_count=t.internal_count.at[nid].set(pc),
+        left_child=t.left_child.at[nid].set(-p - 1),
+        right_child=t.right_child.at[nid].set(-q - 1),
+    )
+    # re-wire the parent pointer that used to reach leaf p
+    prev_node = t.leaf_parent[p]
+    wire_left = jnp.where(apply & (prev_node >= 0) & ~state.leaf_is_right[p],
+                          prev_node, M)
+    wire_right = jnp.where(apply & (prev_node >= 0) & state.leaf_is_right[p],
+                           prev_node, M)
+    t = t._replace(
+        left_child=t.left_child.at[wire_left].set(jnp.where(apply, nid, t.left_child[wire_left])),
+        right_child=t.right_child.at[wire_right].set(jnp.where(apply, nid, t.right_child[wire_right])),
+        leaf_parent=t.leaf_parent.at[p].set(nid).at[q].set(nid),
+        leaf_value=t.leaf_value
+            .at[p].set(leaf_output(lg, lh, spec.lambda_l1, spec.lambda_l2))
+            .at[q].set(leaf_output(rg_, rh_, spec.lambda_l1, spec.lambda_l2)),
+        leaf_count=t.leaf_count.at[p].set(lc).at[q].set(rc_),
+        num_leaves=state.num_leaves_cur + n_apply,
+    )
+    leaf_is_right = state.leaf_is_right.at[p].set(False).at[q].set(True)
+
+    sum_g = state.sum_g.at[p].set(lg).at[q].set(rg_)
+    sum_h = state.sum_h.at[p].set(lh).at[q].set(rh_)
+    cnt = state.cnt.at[p].set(lc).at[q].set(rc_)
+    new_depth = state.leaf_depth[p] + 1
+    leaf_depth = state.leaf_depth.at[p].set(new_depth).at[q].set(new_depth)
+    cand = cand._replace(gain=cand.gain.at[p].set(NEG_INF).at[q].set(NEG_INF))
+
+    # next wave: histogram the smaller child, derive the larger (ref
+    # serial_tree_learner.cpp:354-362)
+    left_smaller = lc <= rc_
+    smaller = jnp.where(left_smaller, p, q)
+    larger = jnp.where(left_smaller, q, p)
+    needs_hist = needs_hist.at[smaller].set(apply, mode="drop")
+    needs_hist = needs_hist.at[L].set(False)
+    sib_leaf = state.sib_leaf.at[smaller].set(larger)
+    parent_cache = state.parent_cache.at[smaller].set(jnp.where(apply, p, L))
+
+    # ---- routing table (applied per row by _route_rows) ----------------
+    # One [L+1, 6] split table resolved per row by table_lookup's one-hot
+    # MXU matmul (each separate [N] table-gather costs ~10-25 ms at 2M
+    # rows; the old 7-gather routing dominated the wave). Columns:
+    #   0: split feature (-1 = leaf not split this wave)
+    #   1: threshold bin
+    #   2: missing bin code (-1 = feature has no missing bin) folded from
+    #      (missing_code, num_bins, default_bin) at split time — the
+    #      reference's NumericalDecision missing handling (tree.h:218)
+    #   3: right-child leaf   4: default_left   5: is_cat
+    sf = cand.feature[p]
+    sf_safe = jnp.maximum(sf, 0)
+    mc_s, nb_s, db_s = (missing_code[sf_safe], num_bins[sf_safe],
+                        default_bin[sf_safe])
+    miss_bin = jnp.where(mc_s == 2, nb_s - 1,
+                         jnp.where(mc_s == 1, db_s, -1))
+    table = jnp.zeros((L + 1, 6), jnp.int32).at[:, 0].set(-1).at[:, 2].set(-1)
+    rows = jnp.stack([sf.astype(jnp.int32), cand.threshold[p],
+                      miss_bin.astype(jnp.int32), q.astype(jnp.int32),
+                      cand.default_left[p].astype(jnp.int32),
+                      cand.is_cat[p].astype(jnp.int32)], axis=-1)
+    table = table.at[p].set(rows, mode="drop").at[L].set(
+        jnp.array([-1, 0, -1, 0, 0, 0], jnp.int32))
+    map_mask = None
+    if spec.use_categorical:
+        map_mask = jnp.zeros((L + 1, B), bool).at[p].set(cand.cat_mask[p],
+                                                         mode="drop")
+
+    done = (n_apply == 0) | (state.num_leaves_cur + n_apply >= L)
+    state2 = GrowState(t, state.leaf_id, hist, sum_g, sum_h, cnt, leaf_depth,
+                       leaf_is_right, cand, needs_hist, sib_leaf, parent_cache,
+                       state.num_leaves_cur + n_apply, done,
+                       state.perm, state.seg_start, state.seg_rows)
+    return state2, table, map_mask, p, q, n_apply
+
+
+def _route_rows(X: jnp.ndarray, lid: jnp.ndarray, table: jnp.ndarray,
+                map_mask: Optional[jnp.ndarray], spec: "GrowerSpec",
+                bundle: Optional[BundleDecode], default_bin: jnp.ndarray):
+    """Step 7: apply one wave's routing table to the rows of ``X``.
+
+    The only wave computation that touches the code matrix besides the
+    histogram build — under streaming it runs per shard (fused ahead of the
+    shard's histogram leg) on exactly these ops. Returns
+    ``(leaf_id, f_row, go_left, right_row)``; the trailing three feed the
+    resident incremental-partition maintenance (step 8)."""
+    packed = table_lookup(lid, table)                         # [N, 6]
+    f_row = packed[:, 0]
+    thr_row = packed[:, 1]
+    miss_row = packed[:, 2]
+    right_row = packed[:, 3]
+    dl_row = packed[:, 4] != 0
+    f_safe = jnp.maximum(f_row, 0)
+    if bundle is None:
+        # split-feature bin via one-hot multiply-sum over the F lanes —
+        # a fused VPU stream, vs take_along_axis's per-row gather
+        f_onehot = f_safe[:, None] == jnp.arange(X.shape[1],
+                                                 dtype=jnp.int32)[None, :]
+        x_bin = jnp.sum(X.astype(jnp.int32) * f_onehot, axis=1)
+    else:
+        x_bin = decode_bundled_bin(X, f_safe, bundle, default_bin)
+    go_left = jnp.where(x_bin == miss_row, dl_row, x_bin <= thr_row)
+    if spec.use_categorical:
+        # categorical routing: bin in the split's left-set -> left
+        # (reference Tree::CategoricalDecision, tree.h:257-284)
+        cat_row = packed[:, 5] != 0
+        go_left_cat = jnp.take_along_axis(map_mask[lid], x_bin[:, None],
+                                          axis=1)[:, 0]
+        go_left = jnp.where(cat_row, go_left_cat, go_left)
+    leaf_id = jnp.where((f_row >= 0), jnp.where(go_left, lid, right_row), lid)
+    return leaf_id, f_row, go_left, right_row
 
 
 def grow_tree(
@@ -508,157 +711,15 @@ def grow_tree(
                                        default_bin)
         new_hist = comm.reduce_hist(new_hist)
 
-        # ---- 3. cache write + sibling by subtraction -----------------------
-        slot_valid = leaf_of_slot < L
-        sibs = state.sib_leaf[leaf_of_slot]                       # [S]
-        parent_rows = state.parent_cache[leaf_of_slot]            # [S]
-        parent_hist = state.hist[parent_rows]                     # [S, F, B, 3]
-        sib_hist = parent_hist - new_hist
-        hist = state.hist
-        hist = hist.at[jnp.where(slot_valid, leaf_of_slot, L)].set(new_hist)
-        hist = hist.at[jnp.where(slot_valid, sibs, L)].set(sib_hist)
-
-        # ---- 4. split scan for the 2S touched leaves -----------------------
-        scan_leaves = jnp.concatenate([leaf_of_slot, jnp.where(slot_valid, sibs, L)])
-        scan_hist = jnp.concatenate([new_hist, sib_hist], axis=0)  # [2S, F, B, 3]
-        if bundle is not None and not unbundle_early:
-            scan_hist = _unpack_bundled(
-                scan_hist, scan_bundle, state.sum_g[scan_leaves],
-                state.sum_h[scan_leaves], state.cnt[scan_leaves], default_bin)
-        # candidate features are GLOBAL indices; under feature/data
-        # parallelism this ends in an all-gather argmax across devices
-        # (reference SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207)
-        cand_new = comm.find_splits(
-            scan_hist,
-            state.sum_g[scan_leaves], state.sum_h[scan_leaves], state.cnt[scan_leaves],
-            bm, spec)
-        cand = SplitCandidates(*[
-            old.at[scan_leaves].set(new) for old, new in zip(state.cand, cand_new)])
-        cand = cand._replace(gain=cand.gain.at[L].set(NEG_INF))  # keep scratch row inert
-        needs_hist = jnp.zeros_like(state.needs_hist)
-
-        # ---- 5. choose splits to apply this wave ---------------------------
-        active = leaf_iota < state.num_leaves_cur
-        depth_ok = (spec.max_depth <= 0) | (state.leaf_depth < spec.max_depth)
-        gains = jnp.where(active & depth_ok & jnp.isfinite(cand.gain), cand.gain, NEG_INF)
-        top_gain, top_leaf = jax.lax.top_k(gains, S)
-        budget = L - state.num_leaves_cur
-        cap = min(spec.wave_size, S) if spec.wave_size > 0 else S
-        srank = jnp.arange(S, dtype=jnp.int32)
-        apply = jnp.isfinite(top_gain) & (srank < budget) & (srank < cap)
-        n_apply = jnp.sum(apply.astype(jnp.int32))
-
-        # ---- 6. apply: tree arrays + leaf state ----------------------------
-        p = jnp.where(apply, top_leaf, L)                         # split leaf (L=dummy)
-        nid = jnp.where(apply, state.num_leaves_cur - 1 + srank, M)  # new internal node
-        q = jnp.where(apply, state.num_leaves_cur + srank, L)     # new right leaf
-
-        lg = cand.left_g[p]
-        lh = cand.left_h[p]
-        lc = cand.left_c[p]
-        pg, ph, pc = state.sum_g[p], state.sum_h[p], state.cnt[p]
-        rg_, rh_, rc_ = pg - lg, ph - lh, pc - lc
-
-        t = state.tree
-        t = t._replace(
-            split_feature=t.split_feature.at[nid].set(cand.feature[p]),
-            threshold_bin=t.threshold_bin.at[nid].set(cand.threshold[p]),
-            default_left=t.default_left.at[nid].set(cand.default_left[p]),
-            is_cat=t.is_cat.at[nid].set(cand.is_cat[p]),
-            cat_mask=t.cat_mask.at[nid].set(cand.cat_mask[p]),
-            split_gain=t.split_gain.at[nid].set(cand.gain[p]),
-            internal_value=t.internal_value.at[nid].set(
-                leaf_output(pg, ph, spec.lambda_l1, spec.lambda_l2)),
-            internal_count=t.internal_count.at[nid].set(pc),
-            left_child=t.left_child.at[nid].set(-p - 1),
-            right_child=t.right_child.at[nid].set(-q - 1),
-        )
-        # re-wire the parent pointer that used to reach leaf p
-        prev_node = t.leaf_parent[p]
-        wire_left = jnp.where(apply & (prev_node >= 0) & ~state.leaf_is_right[p],
-                              prev_node, M)
-        wire_right = jnp.where(apply & (prev_node >= 0) & state.leaf_is_right[p],
-                               prev_node, M)
-        t = t._replace(
-            left_child=t.left_child.at[wire_left].set(jnp.where(apply, nid, t.left_child[wire_left])),
-            right_child=t.right_child.at[wire_right].set(jnp.where(apply, nid, t.right_child[wire_right])),
-            leaf_parent=t.leaf_parent.at[p].set(nid).at[q].set(nid),
-            leaf_value=t.leaf_value
-                .at[p].set(leaf_output(lg, lh, spec.lambda_l1, spec.lambda_l2))
-                .at[q].set(leaf_output(rg_, rh_, spec.lambda_l1, spec.lambda_l2)),
-            leaf_count=t.leaf_count.at[p].set(lc).at[q].set(rc_),
-            num_leaves=state.num_leaves_cur + n_apply,
-        )
-        leaf_is_right = state.leaf_is_right.at[p].set(False).at[q].set(True)
-
-        sum_g = state.sum_g.at[p].set(lg).at[q].set(rg_)
-        sum_h = state.sum_h.at[p].set(lh).at[q].set(rh_)
-        cnt = state.cnt.at[p].set(lc).at[q].set(rc_)
-        new_depth = state.leaf_depth[p] + 1
-        leaf_depth = state.leaf_depth.at[p].set(new_depth).at[q].set(new_depth)
-        cand = cand._replace(gain=cand.gain.at[p].set(NEG_INF).at[q].set(NEG_INF))
-
-        # next wave: histogram the smaller child, derive the larger (ref
-        # serial_tree_learner.cpp:354-362)
-        left_smaller = lc <= rc_
-        smaller = jnp.where(left_smaller, p, q)
-        larger = jnp.where(left_smaller, q, p)
-        needs_hist = needs_hist.at[smaller].set(apply, mode="drop")
-        needs_hist = needs_hist.at[L].set(False)
-        sib_leaf = state.sib_leaf.at[smaller].set(larger)
-        parent_cache = state.parent_cache.at[smaller].set(jnp.where(apply, p, L))
+        # ---- 3-6 + routing table: the shared wave tail ---------------------
+        state2, table, map_mask, p, q, _n_apply = _apply_wave_splits(
+            state, new_hist, leaf_of_slot, bm, spec, comm,
+            scan_bundle if (bundle is not None and not unbundle_early)
+            else None, num_bins, missing_code, default_bin)
 
         # ---- 7. route rows of split leaves ---------------------------------
-        # One [L+1, 6] split table resolved per row by table_lookup's one-hot
-        # MXU matmul (each separate [N] table-gather costs ~10-25 ms at 2M
-        # rows; the old 7-gather routing dominated the wave). Columns:
-        #   0: split feature (-1 = leaf not split this wave)
-        #   1: threshold bin
-        #   2: missing bin code (-1 = feature has no missing bin) folded from
-        #      (missing_code, num_bins, default_bin) at split time — the
-        #      reference's NumericalDecision missing handling (tree.h:218)
-        #   3: right-child leaf   4: default_left   5: is_cat
-        sf = cand.feature[p]
-        sf_safe = jnp.maximum(sf, 0)
-        mc_s, nb_s, db_s = (missing_code[sf_safe], num_bins[sf_safe],
-                            default_bin[sf_safe])
-        miss_bin = jnp.where(mc_s == 2, nb_s - 1,
-                             jnp.where(mc_s == 1, db_s, -1))
-        table = jnp.zeros((L + 1, 6), jnp.int32).at[:, 0].set(-1).at[:, 2].set(-1)
-        rows = jnp.stack([sf.astype(jnp.int32), cand.threshold[p],
-                          miss_bin.astype(jnp.int32), q.astype(jnp.int32),
-                          cand.default_left[p].astype(jnp.int32),
-                          cand.is_cat[p].astype(jnp.int32)], axis=-1)
-        table = table.at[p].set(rows, mode="drop").at[L].set(
-            jnp.array([-1, 0, -1, 0, 0, 0], jnp.int32))
-
-        lid = state.leaf_id
-        packed = table_lookup(lid, table)                         # [N, 6]
-        f_row = packed[:, 0]
-        thr_row = packed[:, 1]
-        miss_row = packed[:, 2]
-        right_row = packed[:, 3]
-        dl_row = packed[:, 4] != 0
-        f_safe = jnp.maximum(f_row, 0)
-        if bundle is None:
-            # split-feature bin via one-hot multiply-sum over the F lanes —
-            # a fused VPU stream, vs take_along_axis's per-row gather
-            f_onehot = f_safe[:, None] == jnp.arange(X.shape[1],
-                                                     dtype=jnp.int32)[None, :]
-            x_bin = jnp.sum(X.astype(jnp.int32) * f_onehot, axis=1)
-        else:
-            x_bin = decode_bundled_bin(X, f_safe, bundle, default_bin)
-        go_left = jnp.where(x_bin == miss_row, dl_row, x_bin <= thr_row)
-        if spec.use_categorical:
-            # categorical routing: bin in the split's left-set -> left
-            # (reference Tree::CategoricalDecision, tree.h:257-284)
-            cat_row = packed[:, 5] != 0
-            map_mask = jnp.zeros((L + 1, B), bool).at[p].set(cand.cat_mask[p],
-                                                            mode="drop")
-            go_left_cat = jnp.take_along_axis(map_mask[lid], x_bin[:, None],
-                                              axis=1)[:, 0]
-            go_left = jnp.where(cat_row, go_left_cat, go_left)
-        leaf_id = jnp.where((f_row >= 0), jnp.where(go_left, lid, right_row), lid)
+        leaf_id, f_row, go_left, right_row = _route_rows(
+            X, state.leaf_id, table, map_mask, spec, bundle, default_bin)
 
         # ---- 8. incremental partition maintenance --------------------------
         # The reference's DataPartition::Split (data_partition.hpp:94): only
@@ -716,11 +777,8 @@ def grow_tree(
             perm, seg_start, seg_rows = (state.perm, state.seg_start,
                                          state.seg_rows)
 
-        done = (n_apply == 0) | (state.num_leaves_cur + n_apply >= L)
-        return GrowState(t, leaf_id, hist, sum_g, sum_h, cnt, leaf_depth,
-                         leaf_is_right, cand, needs_hist, sib_leaf, parent_cache,
-                         state.num_leaves_cur + n_apply, done,
-                         perm, seg_start, seg_rows)
+        return state2._replace(leaf_id=leaf_id, perm=perm,
+                               seg_start=seg_start, seg_rows=seg_rows)
 
     def cond(state: GrowState):
         return ~state.done
@@ -739,3 +797,342 @@ def grow_tree(
         leaf_value=tr.leaf_value.at[L].set(0.0),
         internal_value=tr.internal_value.at[M].set(0.0))
     return tr, final.leaf_id
+
+
+# ======================================================================
+# Out-of-core streamed growth (tpu_residency=stream; ops/stream.py)
+# ======================================================================
+
+class StreamedGrower:
+    """Host-driven out-of-core twin of :func:`grow_tree`.
+
+    The resident grower is ONE jitted while_loop over waves with the whole
+    code matrix in HBM. Here the packed bin codes live in host-resident
+    row shards (ops/stream.py HostShardStore) and each wave makes one pass
+    over them:
+
+    - a per-shard jitted ``shard_pass`` first routes the shard's rows by
+      the PREVIOUS wave's split table (so routing and the histogram read
+      share one H2D transfer of the shard), then folds the shard's chunk
+      partials into the carried accumulator via ``build_histograms``'s
+      ``acc_init`` thread — the identical chunk-add sequence the resident
+      full pass produces, so streamed training is BIT-identical to
+      ``tpu_residency=device`` with ``tpu_row_compact=false``;
+    - a once-per-wave jitted ``wave_update`` reduces the accumulator
+      (``comm.reduce_hist`` — the same collective call site) and applies
+      splits through the SAME :func:`_apply_wave_splits` the resident wave
+      body uses.
+
+    Per-row training state (leaf_id) and the split tables stay
+    device-resident; ONLY the compressed bin codes stream H2D (arXiv
+    1806.11248's design point), double-buffered so shard i+1's copy
+    overlaps shard i's compute (arXiv 2005.09148). The prefetcher's device
+    buffers are deliberately NEVER donated to any jitted fn — donation
+    would let XLA scribble over a buffer the prefetcher may still hand
+    out, so only the carried (acc, comp, leaf_id) ping-pong via
+    ``donate_argnums``.
+
+    The host drives the wave loop, so it fetches one (done, n_apply)
+    scalar pair per wave — the streamed analog of the resident loop's
+    device-side cond, and the one audited host sync. Every jitted fn here
+    is shape-stable across waves, trees, and iterations: steady-state
+    streamed waves add ZERO jit cache misses (pinned by
+    tests/test_stream.py under RecompileGuard).
+
+    Distributed (tree_learner=data|voting): the jitted legs run under
+    shard_map with the resident specs — rows row-sharded, split state
+    replicated — and the host store interleaves shards so device d always
+    receives the SAME rows it would hold resident (ops/stream.py
+    HostShardStore block layout); the per-device fold order is therefore
+    unchanged and the identity extends to multi-chip training.
+    """
+
+    def __init__(self, spec: GrowerSpec, pctx, comm, *, n_rows_padded: int,
+                 local_shard_rows: int, n_shards: int, num_cols: int,
+                 code_mode: str, num_bins, missing_code, default_bin,
+                 is_cat, bundle: Optional[BundleDecode] = None):
+        self.spec = spec
+        self.pctx = pctx
+        self.comm = comm
+        self.bundle = bundle
+        self.n_rows_padded = n_rows_padded
+        self.local_shard_rows = local_shard_rows   # rows per shard PER DEVICE
+        self.n_shards = n_shards
+        self.num_cols = num_cols                   # unpacked code-matrix width
+        self.code_mode = code_mode
+        self.num_bins = num_bins
+        self.missing_code = missing_code
+        self.default_bin = default_bin
+        self.is_cat = is_cat
+        self.wmode = "f32" if spec.hist_f64 else spec.hist_hilo
+        # serial comm when none supplied (mirrors grow_tree)
+        if comm is None:
+            from .parallel.comm import SerialComm
+            self.comm = SerialComm(spec.num_features)
+        # EFB placement mirrors grow_tree: row-sharded strategies unpack
+        # BEFORE the collective, serial unpacks at scan time
+        self.unbundle_early = (bundle is not None
+                               and getattr(self.comm, "axis", None) is not None
+                               and not getattr(self.comm, "bundled_blocks",
+                                               False))
+        assert not getattr(self.comm, "bundled_blocks", False), \
+            "streamed growth does not run under feature-parallel bundling"
+        self._mesh = pctx.mesh if pctx is not None else None
+        self._n_dev = pctx.num_devices if self._mesh is not None else 1
+        from .ops.histogram import num_channels
+        self._ch = num_channels(self.wmode)
+        self._B_hist = spec.hist_bins or spec.num_bins_padded
+        self._build_fns()
+
+    # ------------------------------------------------------------ jitted fns
+
+    def _wrap(self, fn, in_specs, out_specs, donate=()):
+        """shard_map under a mesh (resident specs), plain fn otherwise —
+        then jit with donation (skipped on CPU, which ignores it loudly)."""
+        if self._mesh is not None:
+            from .parallel.comm import _shard_map
+            fn = _shard_map(fn, mesh=self._mesh, in_specs=in_specs,
+                            out_specs=out_specs)
+        if self.pctx is not None and \
+                self.pctx.devices[0].platform == "cpu":
+            donate = ()
+        return jax.jit(fn, donate_argnums=donate)
+
+    def _build_fns(self):
+        spec = self.spec
+        comm = self.comm
+        L = spec.num_leaves
+        M = L - 1
+        S = spec.hist_slots
+        B = spec.num_bins_padded
+        B_hist = self._B_hist
+        ch = self._ch
+        Rd = self.local_shard_rows
+        F_cols = self.num_cols
+        D = self._n_dev
+        bundle = self.bundle
+        from jax.sharding import PartitionSpec as P
+        axis = self.pctx.ROW_AXIS if self._mesh is not None else None
+        rows = P(axis) if axis else None
+        rows2d = P(axis, None) if axis else None
+        accs = P(axis, None, None, None) if axis else None
+        repl = P() if axis else None
+        from .ops.histogram import (build_histograms, finalize_histograms,
+                                    unpack_codes)
+
+        if self.unbundle_early:
+            F_cache = comm.reduced_hist_features(spec.num_features)
+            B_cache = B
+        else:
+            F_cache = comm.reduced_hist_features(F_cols)
+            B_cache = B_hist
+
+        def init_body(grad, hess, included):
+            rg, rh, rc = comm.reduce_scalars(
+                *root_sums(grad, hess, included))
+            n_local = grad.shape[0]
+            state = GrowState(
+                tree=_empty_tree(L, B),
+                leaf_id=jnp.zeros((), jnp.int32),   # per-row leaf_id is
+                                                    # carried SEPARATELY
+                hist=jnp.zeros((L + 1, F_cache, B_cache, 3), jnp.float32),
+                sum_g=jnp.zeros(L + 1, jnp.float32).at[0].set(rg),
+                sum_h=jnp.zeros(L + 1, jnp.float32).at[0].set(rh),
+                cnt=jnp.zeros(L + 1, jnp.float32).at[0].set(rc),
+                leaf_depth=jnp.zeros(L + 1, jnp.int32),
+                leaf_is_right=jnp.zeros(L + 1, bool),
+                cand=_empty_cand(L, B),
+                needs_hist=jnp.zeros(L + 1, bool).at[0].set(True),
+                sib_leaf=jnp.full(L + 1, L, jnp.int32),
+                parent_cache=jnp.full(L + 1, L, jnp.int32),
+                num_leaves_cur=jnp.asarray(1, jnp.int32),
+                done=jnp.asarray(False),
+            )
+            leaf_id = jnp.zeros(n_local, jnp.int32)
+            # wave-1 routing table: every leaf "not split" -> identity route
+            table0 = jnp.zeros((L + 1, 6), jnp.int32) \
+                .at[:, 0].set(-1).at[:, 2].set(-1)
+            map_mask0 = (jnp.zeros((L + 1, B), bool)
+                         if spec.use_categorical else None)
+            return state, leaf_id, table0, map_mask0
+
+        self.init_fn = self._wrap(
+            init_body, in_specs=(rows, rows, rows),
+            out_specs=(repl, rows, repl, repl))
+
+        def slot_body(needs_hist):
+            # step 1 of the resident wave, verbatim
+            leaf_iota = jnp.arange(L + 1, dtype=jnp.int32)
+            pending = needs_hist
+            slot_rank = jnp.cumsum(pending.astype(jnp.int32)) - 1
+            slot_of_leaf = jnp.where(pending, slot_rank, -1).astype(jnp.int32)
+            leaf_of_slot = jnp.full(S, L, jnp.int32).at[
+                jnp.where(pending, slot_rank, S)
+            ].set(leaf_iota, mode="drop")
+            return slot_of_leaf, leaf_of_slot
+
+        self.slot_fn = jax.jit(slot_body)
+
+        def zeros_body():
+            acc = jnp.zeros((D, F_cols, B_hist, S * ch), jnp.float32)
+            comp = (jnp.zeros_like(acc) if spec.hist_f64
+                    else jnp.zeros((D,), jnp.float32))
+            return acc, comp
+
+        # fresh accumulator buffers each wave: (acc, comp) are DONATED into
+        # every shard_pass, so a cached zero array would be written over
+        self.zeros_fn = self._wrap(zeros_body, in_specs=(),
+                                   out_specs=(accs, accs if spec.hist_f64
+                                              else rows))
+
+        def shard_body(acc, comp, codes_sh, leaf_id, g, h, m,
+                       slot_of_leaf, table, map_mask, i):
+            start = i * Rd
+            lid_sh = jax.lax.dynamic_slice_in_dim(leaf_id, start, Rd)
+            codes = unpack_codes(codes_sh, F_cols, self.code_mode)
+            # route by the PREVIOUS wave's table first (wave 1 arrives with
+            # the inert table): one shard transfer serves both legs
+            new_lid, _, _, _ = _route_rows(codes, lid_sh, table, map_mask,
+                                           spec, bundle, self.default_bin)
+            leaf_id = jax.lax.dynamic_update_slice_in_dim(
+                leaf_id, new_lid, start, 0)
+            g_sh = jax.lax.dynamic_slice_in_dim(g, start, Rd)
+            h_sh = jax.lax.dynamic_slice_in_dim(h, start, Rd)
+            m_sh = jax.lax.dynamic_slice_in_dim(m, start, Rd)
+            acc_l = acc[0]
+            acc_l, comp_l = build_histograms(
+                codes, g_sh, h_sh, m_sh, new_lid, slot_of_leaf,
+                num_slots=S, num_bins_padded=B_hist,
+                chunk_rows=spec.chunk_rows, hilo=self.wmode,
+                compensated=spec.hist_f64, acc_init=acc_l,
+                comp_init=comp[0] if spec.hist_f64 else None,
+                raw_output=True)
+            if not spec.hist_f64:
+                comp_l = jnp.zeros((), jnp.float32)
+            return acc_l[None], comp_l[None], leaf_id
+
+        self.shard_fn = self._wrap(
+            shard_body,
+            in_specs=(accs, accs if spec.hist_f64 else rows, rows2d, rows,
+                      rows, rows, rows, repl, repl, repl, repl),
+            out_specs=(accs, accs if spec.hist_f64 else rows, rows),
+            donate=(0, 1, 3))
+
+        def wave_body(state, acc, leaf_of_slot, feature_ok):
+            bm = comm.block_meta(feature_ok, self.num_bins,
+                                 self.missing_code, self.default_bin,
+                                 self.is_cat)
+            new_hist = finalize_histograms(acc[0], S, self.wmode)
+            if self.unbundle_early:
+                lpg = jnp.sum(new_hist[:, 0, :, 0], axis=-1)
+                lph = jnp.sum(new_hist[:, 0, :, 1], axis=-1)
+                lpc = jnp.sum(new_hist[:, 0, :, 2], axis=-1)
+                new_hist = _unpack_bundled(new_hist, bundle, lpg, lph, lpc,
+                                           self.default_bin)
+            new_hist = comm.reduce_hist(new_hist)
+            scan_bundle = bundle if (bundle is not None
+                                     and not self.unbundle_early) else None
+            state2, table, map_mask, _p, _q, n_apply = _apply_wave_splits(
+                state, new_hist, leaf_of_slot, bm, spec, comm, scan_bundle,
+                self.num_bins, self.missing_code, self.default_bin)
+            return state2, table, map_mask, state2.done, n_apply
+
+        self.wave_fn = self._wrap(
+            wave_body, in_specs=(repl, accs, repl, repl),
+            out_specs=(repl, repl, repl, repl, repl))
+
+        def route_body(codes_sh, leaf_id, table, map_mask, i):
+            # trailing routing pass: the final wave applied splits the next
+            # hist pass will never run for — rows still must reach them
+            start = i * Rd
+            lid_sh = jax.lax.dynamic_slice_in_dim(leaf_id, start, Rd)
+            codes = unpack_codes(codes_sh, F_cols, self.code_mode)
+            new_lid, _, _, _ = _route_rows(codes, lid_sh, table, map_mask,
+                                           spec, bundle, self.default_bin)
+            return jax.lax.dynamic_update_slice_in_dim(
+                leaf_id, new_lid, start, 0)
+
+        self.route_fn = self._wrap(
+            route_body, in_specs=(rows2d, rows, repl, repl, repl),
+            out_specs=rows, donate=(1,))
+
+        def finalize_body(tree):
+            # scratch-row zeroing, exactly as grow_tree's loop exit
+            return tree._replace(
+                leaf_value=tree.leaf_value.at[L].set(0.0),
+                internal_value=tree.internal_value.at[M].set(0.0))
+
+        self.finalize_fn = jax.jit(finalize_body)
+
+    # ------------------------------------------------------------- host loop
+
+    def jit_entrypoints(self):
+        """(name, jitted fn) pairs for RecompileGuard registration."""
+        return [("stream.init", self.init_fn), ("stream.slot", self.slot_fn),
+                ("stream.zeros", self.zeros_fn),
+                ("stream.shard_pass", self.shard_fn),
+                ("stream.wave_update", self.wave_fn),
+                ("stream.route", self.route_fn),
+                ("stream.finalize", self.finalize_fn)]
+
+    @allowed_host_sync("streamed wave loop: one (done, n_apply) scalar "
+                       "pair per wave — the host drives the wave loop in "
+                       "stream mode")
+    def _fetch_wave_flags(self, done, n_apply):
+        """One (done, n_apply) scalar fetch per wave — the host-driven
+        loop's termination test (the streamed analog of the resident
+        while_loop cond). Wrapped so the sync point is a single audited
+        site."""
+        d, n = jax.device_get((done, n_apply))
+        return bool(d), int(n)
+
+    def grow(self, stream, grad, hess, included, feature_ok):
+        """Grow one tree over the streamed shards; returns
+        ``(tree arrays, final leaf_id per row)`` exactly like grow_tree.
+        ``stream`` is an ops/stream.ShardPrefetcher over the booster's
+        HostShardStore; grad/hess/included are the bagging-masked per-row
+        arrays (device-resident throughout)."""
+        from .observability import costs as obs_costs
+        state, leaf_id, table, map_mask = self.init_fn(grad, hess, included)
+        cost_dims = dict(rows_padded=int(self.n_rows_padded),
+                         n_shards=int(self.n_shards),
+                         shard_rows=int(self.local_shard_rows * self._n_dev),
+                         features=int(self.num_cols),
+                         hist_slots=int(self.spec.hist_slots),
+                         residency="stream")
+        while True:
+            slot_of_leaf, leaf_of_slot = self.slot_fn(state.needs_hist)
+            acc, comp = self.zeros_fn()
+            for i in range(self.n_shards):
+                codes = stream.get(i)
+                if obs_costs.enabled():
+                    # per-shard cost leg of the dispatch protocol — capture
+                    # dedupes on the callable, so this is a no-op after
+                    # the first wave (compile-time only, no recompile)
+                    obs_costs.capture_jit(
+                        "train_step.stream.shard_pass", self.shard_fn,
+                        args=(acc, comp, codes, leaf_id, grad, hess,
+                              included, slot_of_leaf, table, map_mask,
+                              np.int32(i)), dims=cost_dims)
+                acc, comp, leaf_id = self.shard_fn(
+                    acc, comp, codes, leaf_id, grad, hess, included,
+                    slot_of_leaf, table, map_mask, np.int32(i))
+                # issue shard i+1's H2D while the device chews shard i
+                stream.prefetch(i + 1)
+            if obs_costs.enabled():
+                obs_costs.capture_jit(
+                    "train_step.stream.wave_update", self.wave_fn,
+                    args=(state, acc, leaf_of_slot, feature_ok),
+                    dims=cost_dims)
+            state, table, map_mask, done, n_apply = self.wave_fn(
+                state, acc, leaf_of_slot, feature_ok)
+            done_h, n_apply_h = self._fetch_wave_flags(done, n_apply)
+            if done_h:
+                if n_apply_h:
+                    for i in range(self.n_shards):
+                        codes = stream.get(i)
+                        leaf_id = self.route_fn(codes, leaf_id, table,
+                                                map_mask, np.int32(i))
+                        stream.prefetch(i + 1)
+                break
+        return self.finalize_fn(state.tree), leaf_id

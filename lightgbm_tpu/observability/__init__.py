@@ -39,6 +39,7 @@ zero new host syncs inside the fused step.
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, Optional
 
 from .metrics import MetricsRegistry
@@ -46,6 +47,15 @@ from .phases import PhaseBreakdown  # noqa: F401  (public: bench phase timing)
 from .tracer import SpanTracer
 
 ENV_TELEMETRY_DIR = "LGBM_TPU_TELEMETRY_DIR"
+
+
+def clock() -> float:
+    """Monotonic wall-clock for package modules whose measurements FEED the
+    registry/trace (the streaming prefetcher's stall accounting,
+    ops/stream.py). tpu-lint R008 keeps raw ``time.perf_counter()`` out of
+    package code so no timing lives outside observability; this is the one
+    sanctioned source for code that reports its numbers here."""
+    return time.perf_counter()
 
 _registry = MetricsRegistry()
 _tracer = SpanTracer()

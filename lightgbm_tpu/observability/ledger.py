@@ -75,7 +75,8 @@ def normalize_bench(payload: Optional[Dict], source: str,
     e: Dict = {"source": source, "round": round_, "kind": "bench",
                "value": None, "unit": None, "vs_baseline": None,
                "platform": None, "rows": None, "kernel": None,
-               "n_devices": None, "tree_batch": None, "auc": None,
+               "n_devices": None, "residency": None, "tree_batch": None,
+               "auc": None,
                "recompiles_post_warmup": None, "host_syncs": None,
                "steady_s_per_iter": None, "hbm_peak_gb": None,
                "cost": None, "error": None}
@@ -83,8 +84,8 @@ def normalize_bench(payload: Optional[Dict], source: str,
         e["error"] = "unparseable history file"
         return e
     for k in ("value", "unit", "vs_baseline", "platform", "rows", "kernel",
-              "n_devices", "tree_batch", "auc", "recompiles_post_warmup",
-              "hbm_peak_gb", "error"):
+              "n_devices", "residency", "tree_batch", "auc",
+              "recompiles_post_warmup", "hbm_peak_gb", "error"):
         if payload.get(k) is not None:
             e[k] = payload[k]
     head = (payload.get("phase_timings") or {}).get("headline") or {}
@@ -136,7 +137,10 @@ def load_history(root: str) -> List[Dict]:
     """Normalized entries from every checked-in BENCH/MULTICHIP file,
     round order."""
     entries: List[Dict] = []
+    # STREAM_r*.json (bench.py --stream) shares the bench schema; its
+    # residency=stream field keys it into its own comparability class
     for pat, norm in (("BENCH_r*.json", normalize_bench),
+                      ("STREAM_r*.json", normalize_bench),
                       ("MULTICHIP_r*.json", normalize_multichip)):
         for path in sorted(glob.glob(os.path.join(root, pat))):
             entries.append(norm(payload_of(path), os.path.basename(path),
@@ -155,14 +159,17 @@ def _clean(e: Dict) -> bool:
 
 def comparability_key(e: Dict) -> str:
     """Entries are only compared within the same platform, scale, kernel,
-    and device count — a 2.1M-row quick pre-bank must never be judged
-    against the 10.5M headline, a CPU fallback against a TPU number, a
-    deliberate ``LGBM_TPU_BENCH_KERNEL`` A/B arm against a different
-    kernel's best, or a single-chip headline against an 8-chip mesh run
-    (``n_devices`` is None on the pre-multichip history — those entries
-    keep comparing among themselves)."""
+    device count, and residency — a 2.1M-row quick pre-bank must never be
+    judged against the 10.5M headline, a CPU fallback against a TPU
+    number, a deliberate ``LGBM_TPU_BENCH_KERNEL`` A/B arm against a
+    different kernel's best, a single-chip headline against an 8-chip
+    mesh run, or a host-streamed out-of-core run
+    (``tpu_residency=stream``, which pays H2D per wave by design) against
+    a fully device-resident one. Fields absent on older history are None
+    — those entries keep comparing among themselves."""
     return (f"platform={e.get('platform')}|rows={e.get('rows')}"
-            f"|kernel={e.get('kernel')}|n_devices={e.get('n_devices')}")
+            f"|kernel={e.get('kernel')}|n_devices={e.get('n_devices')}"
+            f"|residency={e.get('residency')}")
 
 
 def multichip_key(e: Dict) -> str:

@@ -82,6 +82,28 @@ def device_memory(device=None) -> Dict:
 
 # ------------------------------------------------------ analytic pre-flight
 
+def hbm_budget_bytes(config=None) -> Optional[int]:
+    """The per-device HBM budget the residency decision judges against:
+    env ``LGBM_TPU_HBM_BUDGET`` > config ``tpu_hbm_budget_bytes`` > the
+    capacity the backend reports (None when nothing is known — CPU
+    backends report no limit). The artificial knobs exist so out-of-core
+    behavior is testable on any host (bench.py --stream trains a dataset
+    >= 4x a configured budget on CPU)."""
+    import os
+    env = os.environ.get("LGBM_TPU_HBM_BUDGET", "")
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            from ..utils.log import Log
+            Log.warning("LGBM_TPU_HBM_BUDGET=%r is not a byte count — "
+                        "ignoring it (use plain bytes, e.g. 17179869184)",
+                        env)
+    if config is not None and getattr(config, "tpu_hbm_budget_bytes", 0) > 0:
+        return int(config.tpu_hbm_budget_bytes)
+    cap = device_memory().get("capacity_bytes")
+    return int(cap) if cap else None
+
 def estimate_wave_residency(*, rows: int, cols: int, code_itemsize: int,
                             num_models: int, num_leaves: int,
                             hist_cols: int, hist_bins: int,
@@ -93,14 +115,19 @@ def estimate_wave_residency(*, rows: int, cols: int, code_itemsize: int,
                             incremental: bool = True, bagging: bool = False,
                             has_weight: bool = False, tree_batch: int = 1,
                             compensated: bool = False,
-                            valid_bytes: int = 0) -> Dict:
+                            valid_bytes: int = 0,
+                            stream_shard_bytes: int = 0) -> Dict:
     """Per-device HBM residency of one training step, by component (bytes).
 
     ``rows``/``cols`` are the PADDED per-device dims the step actually
     dispatches ([Npad(/D), cols_pad]); the model mirrors the buffers the
     grower documents (GrowState carry + the jit-level donated carry):
 
-    - codes:      the binned (possibly bundled) code matrix
+    - codes:      the binned (possibly bundled) code matrix — or, with
+                  ``stream_shard_bytes`` set (tpu_residency=stream), the
+                  TWO ping-pong shard buffers of the prefetcher: per-shard
+                  instead of full-N residency is the whole point of the
+                  out-of-core mode
     - metadata:   label/pad_mask(/bag_mask/weight) row vectors, f32
     - scores:     the [K, N] carried score (donation keeps ONE copy live)
     - gradients:  g and h, [K, N] f32 each
@@ -116,7 +143,8 @@ def estimate_wave_residency(*, rows: int, cols: int, code_itemsize: int,
     """
     f32 = 4
     comp = {}
-    comp["codes"] = rows * cols * code_itemsize
+    comp["codes"] = (2 * stream_shard_bytes if stream_shard_bytes
+                     else rows * cols * code_itemsize)
     comp["metadata"] = rows * f32 * (2 + int(bagging) + int(has_weight))
     comp["scores"] = num_models * rows * f32
     comp["gradients"] = 2 * num_models * rows * f32
@@ -151,13 +179,25 @@ def hbm_preflight(gbdt) -> Dict:
     # replicates rows but slices columns
     n_dev = max(1, pctx.num_devices)
     rows = gbdt.num_data_padded
-    cols = int(gbdt.Xb.shape[1])
+    residency = getattr(gbdt, "residency", "device")
+    stream_store = getattr(gbdt, "_stream_store", None)
+    if stream_store is not None:
+        # out-of-core: the code matrix never materializes on device — only
+        # the prefetcher's two shard buffers count (per-shard residency)
+        cols = int(stream_store.num_cols)
+        code_itemsize = int(np.dtype(stream_store.dtype).itemsize)
+        stream_shard_bytes = int(stream_store.shard_bytes) // n_dev \
+            if pctx.mesh is not None and pctx.strategy in ("data", "voting") \
+            else int(stream_store.shard_bytes)
+    else:
+        cols = int(gbdt.Xb.shape[1])
+        code_itemsize = int(np.dtype(gbdt.Xb.dtype).itemsize)
+        stream_shard_bytes = 0
     if pctx.mesh is not None and pctx.strategy in ("data", "voting"):
         rows = rows // n_dev
     hist_cols = cols
     if pctx.mesh is not None and pctx.strategy == "feature":
         hist_cols = max(1, cols // n_dev)
-    code_itemsize = int(np.dtype(gbdt.Xb.dtype).itemsize)
     B = spec.num_bins_padded
     B_hist = spec.hist_bins or B
     cache_cols = hist_cols
@@ -195,9 +235,13 @@ def hbm_preflight(gbdt) -> Dict:
                 bagging=bool(getattr(gbdt, "bagging_on", False)),
                 has_weight=gbdt.weight is not None,
                 tree_batch=int(getattr(gbdt, "tree_batch", 1)),
-                compensated=spec.hist_f64, valid_bytes=valid_bytes)
+                compensated=spec.hist_f64, valid_bytes=valid_bytes,
+                stream_shard_bytes=stream_shard_bytes)
     est = estimate_wave_residency(**dims)
     est["dims"] = dims
+    est["residency"] = residency
+    if stream_store is not None:
+        est["stream"] = stream_store.describe()
     from . import get_registry
     reg = get_registry()
     reg.gauge("memory.preflight.total_bytes").set(est["total_bytes"])
@@ -206,27 +250,53 @@ def hbm_preflight(gbdt) -> Dict:
     return est
 
 
-def log_budget(estimate: Dict, devmem: Optional[Dict] = None) -> bool:
+def log_budget(estimate: Dict, devmem: Optional[Dict] = None,
+               budget: Optional[int] = None) -> bool:
     """The engine.train budget line: one INFO line with the breakdown, and
-    a WARNING when the estimate exceeds the reported device capacity.
-    Returns True when the estimate fits (or capacity is unknown)."""
+    a WARNING when the estimate exceeds the budget (``tpu_hbm_budget_bytes``
+    / env / reported device capacity). Returns True when the estimate fits
+    (or no budget is known).
+
+    Residency-aware: under ``tpu_residency=stream`` the estimate already
+    counts only the two ping-pong shard buffers, the line says so, and the
+    warning fires only when even the STREAMED state does not fit. Under
+    forced device residency the warning points at ``tpu_residency=stream``
+    as the remedy (auto-selection would already have taken it)."""
     from ..utils.log import Log
 
     comp = estimate["components"]
     top = sorted(comp.items(), key=lambda kv: -kv[1])[:4]
     detail = ", ".join(f"{k} {v / _GB:.2f}" for k, v in top if v)
     devmem = devmem if devmem is not None else device_memory()
-    cap = devmem.get("capacity_bytes")
-    cap_s = f" / {cap / _GB:.2f} GB capacity" if cap else ""
-    Log.info("HBM pre-flight: %.2f GB estimated per device (%s)%s",
-             estimate["total_bytes"] / _GB, detail, cap_s)
+    cap = budget if budget is not None else devmem.get("capacity_bytes")
+    cap_s = f" / {cap / _GB:.2f} GB budget" if cap else ""
+    residency = estimate.get("residency", "device")
+    stream = estimate.get("stream")
+    stream_s = ""
+    if residency == "stream" and stream:
+        stream_s = (f" [tpu_residency=stream: codes in {stream['n_shards']} "
+                    f"host shards x {stream['shard_bytes'] / _GB:.3f} GB, "
+                    f"{stream['code_mode']} packed]")
+    Log.info("HBM pre-flight: %.2f GB estimated per device (%s)%s%s",
+             estimate["total_bytes"] / _GB, detail, cap_s, stream_s)
     if cap and estimate["total_bytes"] > cap:
-        Log.warning(
-            "HBM pre-flight: estimated residency %.2f GB EXCEEDS the "
-            "device capacity %.2f GB (platform=%s) — expect an OOM at "
-            "first dispatch; shrink the dataset/shard it "
-            "(tree_learner=data) or wait for the out-of-core path "
-            "(ROADMAP item 3)", estimate["total_bytes"] / _GB, cap / _GB,
-            devmem.get("platform"))
+        if residency == "stream":
+            Log.warning(
+                "HBM pre-flight: even the STREAMED training state (%.2f "
+                "GB — gradients/scores/partition + two shard buffers) "
+                "exceeds the %.2f GB budget (platform=%s): shrink "
+                "tpu_stream_shard_rows, shard rows across chips "
+                "(tree_learner=data), or lower tree_batch",
+                estimate["total_bytes"] / _GB, cap / _GB,
+                devmem.get("platform"))
+        else:
+            Log.warning(
+                "HBM pre-flight: estimated residency %.2f GB EXCEEDS the "
+                "%.2f GB budget (platform=%s) — expect an OOM at first "
+                "dispatch; set tpu_residency=stream (host-resident code "
+                "shards, docs/TPU-Performance.md) or shard the rows "
+                "across chips (tree_learner=data)",
+                estimate["total_bytes"] / _GB, cap / _GB,
+                devmem.get("platform"))
         return False
     return True

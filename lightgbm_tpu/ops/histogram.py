@@ -321,6 +321,17 @@ def build_histograms(
                                    # accumulates bins in f64, bin.h:29-31)
                                    # without f64 hardware — config
                                    # tpu_hist_f64
+    acc_init: jnp.ndarray = None,  # [F, B, S*ch] f32 accumulator carried in
+                                   # from a PREVIOUS shard of the same wave
+                                   # (out-of-core streaming, ops/stream.py):
+                                   # chunk partials keep folding into it in
+                                   # order, so a sharded pass is bit-identical
+                                   # to one resident pass over the same rows
+    comp_init: jnp.ndarray = None, # Kahan carry matching acc_init
+    raw_output: bool = False,      # return the raw (acc, comp) fold state
+                                   # instead of the finalized histogram —
+                                   # streaming callers finalize once per wave
+                                   # via finalize_histograms
 ) -> jnp.ndarray:
     """Returns hist [num_slots, F, num_bins_padded, 3] f32 (sum_g, sum_h, count).
 
@@ -329,6 +340,12 @@ def build_histograms(
     each gathering its rows through row_idx — the analog of the reference
     histogramming only the smaller leaf's rows
     (serial_tree_learner.cpp:354-362) instead of a full-data pass per wave.
+
+    With ``acc_init``/``raw_output`` the pass is one *shard leg* of a
+    streamed wave (tpu_residency=stream): the accumulator threads through
+    every shard in row order — the identical chunk-partial add sequence the
+    resident pass produces — and ``finalize_histograms`` combines once at
+    the end of the wave.
     """
     n_rows, num_features = X.shape
     assert n_rows % chunk_rows == 0, (n_rows, chunk_rows)
@@ -396,7 +413,9 @@ def build_histograms(
         )                                                         # [F, B, S*ch]
         return part
 
-    acc0 = jnp.zeros((num_features, num_bins_padded, num_slots * ch), jnp.float32)
+    acc0 = (acc_init if acc_init is not None else
+            jnp.zeros((num_features, num_bins_padded, num_slots * ch),
+                      jnp.float32))
     if compensated:
         # Kahan two-sum across chunk partials: the lost low-order bits of
         # every f32 add are carried forward, so the accumulated bin sums are
@@ -412,7 +431,11 @@ def build_histograms(
         def accumulate(carry, i):
             acc, comp = carry
             return acc + chunk_part(i), comp
-    comp0 = jnp.zeros_like(acc0) if compensated else jnp.zeros((), jnp.float32)
+    if comp_init is not None:
+        comp0 = comp_init
+    else:
+        comp0 = jnp.zeros_like(acc0) if compensated \
+            else jnp.zeros((), jnp.float32)
     if compact:
         n_chunks_active = jnp.minimum(
             (n_active + chunk_rows - 1) // chunk_rows, n_chunks)
@@ -422,14 +445,28 @@ def build_histograms(
             acc, comp = accumulate((acc, comp), i)
             return i + 1, acc, comp
 
-        _, acc, _ = jax.lax.while_loop(
+        _, acc, comp = jax.lax.while_loop(
             lambda c: c[0] < n_chunks_active, while_body,
             (jnp.asarray(0, n_chunks_active.dtype), acc0, comp0))
     else:
-        (acc, _), _ = jax.lax.scan(
+        (acc, comp), _ = jax.lax.scan(
             lambda c, i: (accumulate(c, i), ()), (acc0, comp0),
             jnp.arange(n_chunks))
 
+    if raw_output:
+        return acc, comp
+    return finalize_histograms(acc, num_slots, hilo)
+
+
+def finalize_histograms(acc: jnp.ndarray, num_slots: int, hilo
+                        ) -> jnp.ndarray:
+    """[F, B, S*ch] f32 fold state -> [S, F, B, 3] (sum_g, sum_h, count).
+
+    The combine/transpose tail of ``build_histograms``, split out so a
+    streamed wave (which folds shard legs with ``raw_output=True``) runs it
+    exactly once — the identical ops the resident pass ends with."""
+    num_features, num_bins_padded, _ = acc.shape
+    ch = acc.shape[-1] // num_slots
     acc = acc.reshape(num_features, num_bins_padded, num_slots, ch)
     acc = jnp.transpose(acc, (2, 0, 1, 3))                        # [S, F, B, ch]
     return combine_channels(acc, hilo)                            # [S, F, B, 3]

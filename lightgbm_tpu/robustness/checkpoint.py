@@ -62,6 +62,14 @@ VOLATILE_CONFIG_FIELDS = frozenset({
     # device-count check itself lives in restore_checkpoint_state)
     "checkpoint_dir", "checkpoint_interval", "checkpoint_keep_last_n",
     "resume_from", "tpu_reshard_on_resume",
+    # out-of-core transport knobs (docs/Fault-Tolerance.md "resume with a
+    # different shard size"): residency and shard size change WHERE the
+    # codes live and how they move, never the math — the shard size
+    # divides the padded per-device rows, so chunk boundaries, the bagging
+    # RNG shapes, and every histogram fold are identical across values.
+    # The one behavioral coupling (stream forces tpu_row_compact=false) is
+    # covered by tpu_row_compact itself staying fingerprinted.
+    "tpu_residency", "tpu_stream_shard_rows", "tpu_hbm_budget_bytes",
     # cluster wiring: the restarted pod gets fresh addresses/ports
     "machines", "machine_list_file", "local_listen_port", "time_out",
     # profiling/telemetry (observability/: spans, exporters, profiler window)

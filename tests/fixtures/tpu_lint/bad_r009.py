@@ -1,0 +1,20 @@
+"""Fixture: violates exactly R009 — jax.device_put reachable from a scan
+body (a shard upload hand-rolled inside a traced loop instead of going
+through ops/stream.py's prefetcher)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SHARDS = [np.zeros((8, 4), np.uint8)]
+
+
+def fold_shards(acc):
+    def load(i):
+        return jax.device_put(SHARDS[0])         # R009: transfer in a loop
+
+    def body(carry, i):
+        shard = load(i)
+        return carry + jnp.sum(shard), ()
+
+    out, _ = jax.lax.scan(body, acc, jnp.arange(4))
+    return out

@@ -307,9 +307,15 @@ def test_bench_comparability_key_carries_n_devices():
     e = ledger.normalize_bench({"value": 1.0, "platform": "cpu",
                                 "rows": 100, "n_devices": 8},
                                "BENCH_r91.json", 91)
-    assert ledger.comparability_key(e).endswith("|n_devices=8")
+    assert "|n_devices=8" in ledger.comparability_key(e)
     # single-chip history (no field) stays in its own group
     e0 = ledger.normalize_bench({"value": 1.0, "platform": "cpu",
                                  "rows": 100}, "BENCH_r90.json", 90)
-    assert ledger.comparability_key(e0).endswith("|n_devices=None")
+    assert "|n_devices=None" in ledger.comparability_key(e0)
     assert ledger.comparability_key(e) != ledger.comparability_key(e0)
+    # ...and residency (PR 8): streamed runs never judge against resident
+    es = ledger.normalize_bench({"value": 1.0, "platform": "cpu",
+                                 "rows": 100, "residency": "stream"},
+                                "STREAM_r91.json", 91)
+    assert ledger.comparability_key(es).endswith("|residency=stream")
+    assert ledger.comparability_key(es) != ledger.comparability_key(e0)

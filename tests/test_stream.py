@@ -1,0 +1,389 @@
+"""Out-of-core streaming training (tpu_residency=stream; ops/stream.py +
+grower.StreamedGrower + the gbdt streamed step).
+
+Pins the tentpole contracts of the streaming-residency PR:
+
+- streamed training is BIT-identical to device residency on the same data
+  (serial AND tree_learner=data on the 8-device harness, with bagging +
+  feature_fraction RNG and the u4 bit-packed transfer layout) — device
+  arms run tpu_row_compact=false, the math stream mode announces;
+- the host shard packing round-trips byte-exactly through the device
+  unpack, and the shard-size resolver always divides the padded rows (the
+  invariant behind "any shard size resumes any checkpoint");
+- tpu_residency=auto falls back to stream exactly when the analytic
+  estimate exceeds the configured budget;
+- checkpoint kill-and-resume mid-stream is bit-identical, including
+  resuming under a DIFFERENT shard size and into device residency;
+- steady-state streamed waves add ZERO jit cache misses (RecompileGuard
+  over every streamed entrypoint);
+- a forced-stall run (prefetch disabled) with a mostly-padding tail shard
+  still counts every row exactly once;
+- tree_batch is forced to 1 loudly (the decide-and-pin contract) and the
+  unsupported combinations fail loudly.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _make_binary(n=3000, f=10, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    logit = X[:, 0] - 0.5 * X[:, 1] + X[:, 2] * X[:, 3]
+    y = (logit + rng.randn(n).astype(np.float32) * 0.2 > 0.3).astype(
+        np.float32)
+    return X, y
+
+
+BASE = dict(objective="binary", num_leaves=31, learning_rate=0.1,
+            min_data_in_leaf=3, verbose=-1, seed=5, metric="none",
+            tpu_hist_chunk=256, bagging_fraction=0.7, bagging_freq=2,
+            feature_fraction=0.8)
+
+
+def _train(X, y, residency, rounds=6, **extra):
+    params = dict(BASE, tpu_residency=residency, **extra)
+    if residency == "device":
+        # stream mode runs full streaming passes; the device identity arm
+        # must use the same math (docs/TPU-Performance.md)
+        params.setdefault("tpu_row_compact", False)
+    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+def _assert_identical(b1, b2, X):
+    np.testing.assert_array_equal(b1.predict(X), b2.predict(X))
+    np.testing.assert_array_equal(b1.predict(X, raw_score=True),
+                                  b2.predict(X, raw_score=True))
+    assert len(b1.trees) == len(b2.trees)
+    for t1, t2 in zip(b1.trees, b2.trees):
+        np.testing.assert_array_equal(t1.leaf_value, t2.leaf_value)
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+
+
+# ----------------------------------------------------- host shard transport
+
+def test_pack_codes_host_roundtrips_through_device_unpack():
+    """Every byte layout (u8 | u16 | u4 | u6) packed on the host must
+    decode to the identical integer codes through the device-side
+    unpack_codes — the transport-compression half of the bit-identity
+    story."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.histogram import code_bytes_total, unpack_codes
+    from lightgbm_tpu.ops.stream import pack_codes_host
+    rng = np.random.RandomState(0)
+    for mode, hi, dt in [("u8", 256, np.uint8), ("u16", 4000, np.uint16),
+                         ("u4", 16, np.uint8), ("u6", 64, np.uint8)]:
+        for F in (3, 4, 5, 8):
+            X = rng.randint(0, hi, size=(37, F)).astype(dt)
+            pk = pack_codes_host(X, mode)
+            assert pk.dtype == np.uint8
+            assert pk.shape == (37, code_bytes_total(F, mode))
+            back = np.asarray(unpack_codes(jnp.asarray(pk), F, mode))
+            np.testing.assert_array_equal(back, X.astype(np.int32))
+
+
+def test_resolve_shard_rows_divides_exactly():
+    from lightgbm_tpu.ops.stream import resolve_shard_rows
+    for per_dev_chunks in (1, 2, 7, 8, 12, 30):
+        for chunk in (256, 1024):
+            per_dev = per_dev_chunks * chunk
+            for req in (0, chunk, 3 * chunk, 10**9):
+                rd = resolve_shard_rows(per_dev, chunk, req)
+                assert rd % chunk == 0
+                assert per_dev % rd == 0
+    # the request rounds to the nearest achievable divisor
+    assert resolve_shard_rows(12 * 256, 256, 5 * 256) == 4 * 256
+    assert resolve_shard_rows(7 * 256, 256, 3 * 256) == 256  # 7 prime
+
+
+def test_store_interleaves_per_device_blocks():
+    """Under a row-sharded mesh, shard i must hand device d exactly the
+    rows it would hold resident: the i-th sub-block of device d's
+    contiguous block."""
+    from lightgbm_tpu.ops.stream import HostShardStore
+    X = np.arange(16 * 3, dtype=np.uint8).reshape(16, 3) % 7
+    st = HostShardStore(X, n_rows_padded=16, num_cols=3,
+                        local_shard_rows=4, n_devices=2, code_mode="u8")
+    assert st.n_shards == 2
+    # device blocks: rows 0-7 (d0), 8-15 (d1); shard 0 = d0 rows 0-3 then
+    # d1 rows 8-11
+    np.testing.assert_array_equal(
+        st.shards[0], np.concatenate([X[0:4], X[8:12]]))
+    np.testing.assert_array_equal(
+        st.shards[1], np.concatenate([X[4:8], X[12:16]]))
+    # row/col padding applied per block, matching what device residency
+    # would np.pad (tail rows + extra columns are zeros)
+    st2 = HostShardStore(X[:14], n_rows_padded=16, num_cols=4,
+                         local_shard_rows=8, n_devices=1, code_mode="u8")
+    assert st2.n_shards == 2
+    want = np.zeros((16, 4), np.uint8)
+    want[:14, :3] = X[:14]
+    np.testing.assert_array_equal(np.concatenate(st2.shards), want)
+
+
+# ------------------------------------------------------- bit-identity pins
+
+@pytest.mark.parametrize("tree_learner", ["serial", "data"])
+def test_stream_vs_device_bit_identical(tree_learner):
+    """Streamed vs resident, serial and data-parallel on the 8-device
+    harness, with bagging + feature_fraction engaged — the acceptance
+    identity."""
+    X, y = _make_binary()
+    b_st = _train(X, y, "stream", tree_learner=tree_learner,
+                  tpu_stream_shard_rows=512)
+    b_dev = _train(X, y, "device", tree_learner=tree_learner)
+    _assert_identical(b_st, b_dev, X)
+
+
+def test_stream_u4_code_mode_bit_identical():
+    """max_bin=15 engages the u4 nibble-packed TRANSFER layout: the host
+    pack / device unpack must reproduce the identical codes the resident
+    arm reads directly."""
+    X, y = _make_binary(seed=11)
+    b_st = _train(X, y, "stream", max_bin=15, tpu_stream_shard_rows=256)
+    assert b_st._gbdt is None or True  # train() frees the booster state
+    b_dev = _train(X, y, "device", max_bin=15)
+    _assert_identical(b_st, b_dev, X)
+
+
+def test_stream_categorical_valid_sets_bit_identical():
+    """Categorical routing (the map_mask leg of _route_rows) and attached
+    valid sets (resident in the streamed apply leg) both match the device
+    arm, including the per-iteration eval curves."""
+    rng = np.random.RandomState(4)
+    n = 1500
+    X = rng.rand(n, 6).astype(np.float32)
+    X[:, 2] = rng.randint(0, 12, n)
+    y = ((X[:, 0] > 0.5) ^ (X[:, 2] % 3 == 0)).astype(np.float32)
+    Xv, yv = X[:300], y[:300]
+    base = dict(objective="binary", num_leaves=15, min_data_in_leaf=3,
+                verbose=-1, seed=5, metric="binary_logloss",
+                tpu_hist_chunk=256)
+
+    def run(res, extra):
+        p = dict(base, tpu_residency=res, **extra)
+        ev = {}
+        b = lgb.train(p, lgb.Dataset(X, label=y, params=p,
+                                     categorical_feature=[2]),
+                      num_boost_round=4,
+                      valid_sets=[lgb.Dataset(Xv, label=yv)],
+                      valid_names=["v"], evals_result=ev,
+                      verbose_eval=False)
+        return b, ev
+
+    bs, evs = run("stream", dict(tpu_stream_shard_rows=256))
+    bd, evd = run("device", dict(tpu_row_compact=False))
+    np.testing.assert_array_equal(bs.predict(X), bd.predict(X))
+    assert evs == evd
+
+
+def test_stream_multiclass_bit_identical():
+    rng = np.random.RandomState(4)
+    X = rng.rand(1200, 6).astype(np.float32)
+    y = rng.randint(0, 3, 1200).astype(np.float32)
+    base = dict(objective="multiclass", num_class=3, num_leaves=15,
+                min_data_in_leaf=3, verbose=-1, seed=5, metric="none",
+                tpu_hist_chunk=256)
+    bs = lgb.train(dict(base, tpu_residency="stream",
+                        tpu_stream_shard_rows=256),
+                   lgb.Dataset(X, label=y), num_boost_round=3)
+    bd = lgb.train(dict(base, tpu_residency="device",
+                        tpu_row_compact=False),
+                   lgb.Dataset(X, label=y), num_boost_round=3)
+    np.testing.assert_array_equal(bs.predict(X), bd.predict(X))
+
+
+def test_stream_shard_size_never_changes_the_model():
+    """Shard size is pure transport: any value yields the same model —
+    the invariant that makes the knob checkpoint-volatile."""
+    X, y = _make_binary(n=2048, seed=3)
+    b1 = _train(X, y, "stream", rounds=4, tpu_stream_shard_rows=256)
+    b2 = _train(X, y, "stream", rounds=4, tpu_stream_shard_rows=1024)
+    _assert_identical(b1, b2, X)
+
+
+# ------------------------------------------------------------- auto fallback
+
+def test_auto_residency_falls_back_to_stream_on_budget():
+    X, y = _make_binary(n=2000)
+    p = dict(BASE, tpu_residency="auto", tpu_hbm_budget_bytes=50_000)
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.Booster(params=p, train_set=ds)
+    assert bst._gbdt.residency == "stream"
+    assert bst._gbdt._stream_store is not None
+    # the effective config is normalized (stream implies no compaction)
+    assert bst._gbdt.config.tpu_row_compact is False
+
+
+def test_auto_residency_stays_device_within_budget():
+    X, y = _make_binary(n=2000)
+    p = dict(BASE, tpu_residency="auto",
+             tpu_hbm_budget_bytes=10 * (1 << 30))
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.Booster(params=p, train_set=ds)
+    assert bst._gbdt.residency == "device"
+    assert bst._gbdt._stream_store is None
+
+
+def test_stream_preflight_counts_shards_not_full_codes():
+    """hbm_preflight under stream must charge the two ping-pong shard
+    buffers, not the full-N code matrix."""
+    from lightgbm_tpu.observability.memory import hbm_preflight
+    X, y = _make_binary(n=4096)
+    p = dict(BASE, tpu_residency="stream", tpu_stream_shard_rows=256)
+    bst = lgb.Booster(params=p, train_set=lgb.Dataset(X, label=y, params=p))
+    est = hbm_preflight(bst._gbdt)
+    assert est["residency"] == "stream"
+    store = bst._gbdt._stream_store
+    assert est["components"]["codes"] == 2 * store.shard_bytes
+    assert est["components"]["codes"] < store.total_bytes
+
+
+# -------------------------------------------------------- checkpoint/resume
+
+def test_stream_kill_and_resume_bit_identical():
+    """Train 3 + resume 3 == train 6, with the resumed booster using a
+    DIFFERENT shard size, and separately resuming into DEVICE residency —
+    docs/Fault-Tolerance.md's resume-with-different-shard-size semantics."""
+    X, y = _make_binary(n=2048, seed=3)
+    ck = tempfile.mkdtemp(prefix="lgbm_stream_ck_")
+    p = dict(BASE, tpu_residency="stream", tpu_stream_shard_rows=512)
+    b0 = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=6)
+
+    ds = lgb.Dataset(X, label=y, params=p)
+    b1 = lgb.Booster(params=p, train_set=ds)
+    for _ in range(3):
+        b1.update()
+    b1.save_checkpoint(ck)
+
+    p2 = dict(p, tpu_stream_shard_rows=256)
+    b2 = lgb.Booster(params=p2,
+                     train_set=lgb.Dataset(X, label=y, params=p2))
+    b2.resume(ck)
+    for _ in range(3):
+        b2.update()
+    np.testing.assert_array_equal(b0.predict(X), b2.predict(X))
+
+    p3 = dict(p, tpu_residency="device", tpu_row_compact=False)
+    b3 = lgb.Booster(params=p3,
+                     train_set=lgb.Dataset(X, label=y, params=p3))
+    b3.resume(ck)
+    for _ in range(3):
+        b3.update()
+    np.testing.assert_array_equal(b0.predict(X), b3.predict(X))
+
+
+# --------------------------------------------------------- recompile guard
+
+def test_stream_steady_state_adds_zero_recompiles():
+    """Every streamed jitted entrypoint (grower legs + step legs) is
+    shape-stable across waves/trees/iterations: after a 2-iteration
+    warm-up, further iterations compile NOTHING."""
+    from lightgbm_tpu.analysis.guards import RecompileGuard
+    X, y = _make_binary(n=2048)
+    p = dict(BASE, tpu_residency="stream", tpu_stream_shard_rows=256)
+    bst = lgb.Booster(params=p,
+                      train_set=lgb.Dataset(X, label=y, params=p))
+    g = bst._gbdt
+    for _ in range(2):
+        bst.update()
+    np.asarray(g.score).sum()
+    guard = RecompileGuard(label="stream-test")
+    for name, fn in g._streamed_grower.jit_entrypoints():
+        guard.register(fn, name)
+    for name in ("pre", "prep", "shrink", "apply"):
+        guard.register(g._stream_fns[name], name)
+    with guard:
+        guard.mark_warm()
+        for _ in range(3):
+            bst.update()
+        np.asarray(g.score).sum()
+    assert guard.report()["post_warmup_cache_misses"] == 0, guard.report()
+
+
+# ------------------------------------------------- forced stall / tail shard
+
+def test_forced_stall_partial_tail_rows_not_double_counted(monkeypatch):
+    """Prefetch disabled (every shard transfer a measured stall) with a
+    shard size that leaves the tail shard mostly padding: every real row
+    must contribute EXACTLY once — the per-tree root count equals the
+    real row count, and the model matches the resident arm."""
+    monkeypatch.setenv("LGBM_TPU_STREAM_NO_PREFETCH", "1")
+    n = 1500                      # pads to 2048 -> tail shard 3/4 padding
+    X, y = _make_binary(n=n, seed=13)
+    p = dict(BASE, tpu_residency="stream", tpu_stream_shard_rows=256,
+             bagging_fraction=1.0, bagging_freq=0, feature_fraction=1.0)
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.Booster(params=p, train_set=ds)
+    bst.update()
+    g = bst._gbdt
+    assert g._stream.prefetch_enabled is False
+    assert g._stream.hits == 0 and g._stream.stalls > 0
+    # the root's routed-and-counted rows == the real rows, once each
+    bst._ensure_finalized()
+    tree = bst.trees[0]
+    assert float(np.sum(tree.leaf_count)) == pytest.approx(float(n))
+    monkeypatch.delenv("LGBM_TPU_STREAM_NO_PREFETCH")
+    b_dev = _train(X, y, "device", rounds=1, bagging_fraction=1.0,
+                   bagging_freq=0, feature_fraction=1.0)
+    np.testing.assert_array_equal(bst.predict(X), b_dev.predict(X))
+
+
+# -------------------------------------------------------------- guard rails
+
+def test_stream_forces_tree_batch_to_one():
+    """The decide-and-pin contract: tree_batch>1 + stream falls back to 1
+    loudly instead of trapping shard transfers inside a traced scan."""
+    X, y = _make_binary(n=1024)
+    p = dict(BASE, tpu_residency="stream", tree_batch=4)
+    bst = lgb.Booster(params=p,
+                      train_set=lgb.Dataset(X, label=y, params=p))
+    assert bst._gbdt.tree_batch == 1
+    # the streamed run still trains (engine path exercises train_batch)
+    b = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=2)
+    assert len(b.trees) == 2
+
+
+def test_stream_config_validation():
+    with pytest.raises(LightGBMError):
+        lgb.Booster(params=dict(BASE, tpu_residency="bogus"),
+                    train_set=lgb.Dataset(*_make_binary(n=256)))
+    with pytest.raises(LightGBMError):
+        lgb.Booster(params=dict(BASE, tpu_stream_shard_rows=-1),
+                    train_set=lgb.Dataset(*_make_binary(n=256)))
+
+
+def test_stream_rejects_feature_parallel_and_rollback():
+    X, y = _make_binary(n=1024)
+    with pytest.raises(LightGBMError, match="feature"):
+        p = dict(BASE, tpu_residency="stream", tree_learner="feature")
+        lgb.Booster(params=p, train_set=lgb.Dataset(X, label=y, params=p))
+    p = dict(BASE, tpu_residency="stream")
+    bst = lgb.Booster(params=p,
+                      train_set=lgb.Dataset(X, label=y, params=p))
+    bst.update()
+    with pytest.raises(LightGBMError, match="rollback"):
+        bst.rollback_one_iter()
+
+
+def test_stream_nan_policy_skip_iter():
+    """A custom fobj poisons iteration 1's gradients: skip_iter drops that
+    iteration (no tree appended) and training continues — the streamed
+    twin of the resident guard, without ever needing a rollback."""
+    from lightgbm_tpu.robustness.chaos import nan_gradient_fobj
+    X, y = _make_binary(n=1024)
+    p = dict(BASE, tpu_residency="stream", nan_policy="skip_iter",
+             objective="regression", bagging_fraction=1.0, bagging_freq=0)
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.Booster(params=p, train_set=ds)
+    fobj = nan_gradient_fobj([1], seed=0)
+    for _ in range(4):
+        bst.update(fobj=fobj)
+    assert len(bst._gbdt.models) == 3     # the poisoned iteration dropped

@@ -28,6 +28,7 @@ BAD_FIXTURES = [
     ("bad_r006.py", "R006"),
     ("bad_r007.py", "R007"),
     (os.path.join("lightgbm_tpu", "bad_r008.py"), "R008"),
+    ("bad_r009.py", "R009"),
 ]
 
 
@@ -93,6 +94,50 @@ def test_r008_observability_is_exempt():
             rel=os.path.join("lightgbm_tpu", *rel))
         assert err is None
         assert [f for f in findings if f.rule == "R008"] == [], rel
+
+
+def test_r009_ignores_transfers_outside_loops(tmp_path):
+    """Setup-time device_put (construction placement, residency caches)
+    is legitimate — R009 only fires on code reachable from a
+    while_loop/scan body."""
+    p = tmp_path / "mod.py"
+    p.write_text("import jax\nimport numpy as np\n\n\n"
+                 "def place(x):\n"
+                 "    return jax.device_put(np.asarray(x))\n")
+    findings, err = lint_file(str(p))
+    assert err is None and findings == [], [f.format() for f in findings]
+
+
+def test_r009_stream_and_dataset_are_exempt():
+    """ops/stream.py (the prefetcher — the one sanctioned home of mid-loop
+    H2D traffic) and dataset.py (the residency cache) are exempt by
+    path."""
+    for rel in (("ops", "stream.py"), ("dataset.py",)):
+        findings, err = lint_file(
+            os.path.join(REPO, "lightgbm_tpu", *rel),
+            rel=os.path.join("lightgbm_tpu", *rel))
+        assert err is None
+        assert [f for f in findings if f.rule == "R009"] == [], rel
+
+
+def test_r009_fires_on_from_import_alias(tmp_path):
+    """`from jax import device_put` must not dodge the rule."""
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent("""\
+        import jax
+        from jax import device_put
+        import numpy as np
+
+        def run(acc):
+            def body(c, i):
+                return c + device_put(np.zeros(4)).sum(), ()
+            out, _ = jax.lax.scan(body, acc, np.arange(3))
+            return out
+        """))
+    findings, err = lint_file(str(p))
+    assert err is None
+    assert {f.rule for f in findings} == {"R009"}, \
+        [f.format() for f in findings]
 
 
 def test_clean_fixture_has_no_findings():
