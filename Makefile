@@ -10,17 +10,22 @@
 # compile cache in .jax_cache; `check-fast` is ~4 min cold.
 PYTEST := python -m pytest -q
 
-# Static JAX/TPU hygiene pass (rules R001-R009, see docs/Static-Analysis.md).
+# Static JAX/TPU hygiene pass (rules R001-R010, see docs/Static-Analysis.md).
 # Exits non-zero on any finding not covered by tpu_lint_baseline.json.
 lint:
 	python -m lightgbm_tpu.analysis lightgbm_tpu/
 
 # CI gate: lint + tier-1 tests + the recompile guard on a 5-iter smoke run
-# (which also asserts checkpoint save/resume stays recompile-free and pins
-# the fused step's FLOPs/bytes to golden values) + the out-of-core stream
-# smoke (small N, forced budget -> tpu_residency=stream; asserts 0
+# (which also asserts checkpoint save/resume stays recompile-free, that the
+# watchdog + checkpoint-checksum path adds 0 recompiles / 0 host syncs, and
+# pins the fused step's FLOPs/bytes to golden values) + the out-of-core
+# stream smoke (small N, forced budget -> tpu_residency=stream; asserts 0
 # recompiles and bit-identity with the resident output) + the perf-ledger
-# diff.
+# diff. The FAST chaos-matrix arms (corrupt-latest lineage fallback across
+# serial/data8/stream, watchdog fake-clock boundaries, shard-CRC
+# detection, supervisor policy) ride inside the tier-1 line — only the
+# slow supervised kill -9 / hang / shard-restart arms are deferred to
+# `make chaos`.
 verify: lint
 	env JAX_PLATFORMS=cpu $(PYTEST) tests/ -m 'not slow'
 	python bench.py --smoke
@@ -60,10 +65,25 @@ multichip:
 	env LGBM_TPU_MULTICHIP_OUT=$(CURDIR)/MULTICHIP_latest.json python bench.py --multichip
 
 # Fault-injection suite (docs/Fault-Tolerance.md): KV delay/drop/corruption
-# through the chaos harness + all three nan_policy branches + kill-and-resume.
-# The pinned seed makes a failing run replayable bit-for-bit.
+# through the chaos harness, all three nan_policy branches, kill-and-resume,
+# and the self-healing matrix — corrupt-latest lineage fallback, SUPERVISED
+# kill -9 / injected-hang / shard-corruption recovery (real child
+# processes, slow arms included here), each asserting the recovered model
+# is bit-identical to a fault-free run. The pinned seed makes a failing
+# run replayable bit-for-bit.
 chaos:
-	env JAX_PLATFORMS=cpu LGBM_TPU_CHAOS_SEED=1234 $(PYTEST) tests/ -m chaos
+	env JAX_PLATFORMS=cpu LGBM_TPU_CHAOS_SEED=1234 \
+	    LGBM_TPU_COMM_JITTER_SEED=1234 \
+	    $(PYTEST) tests/ -m chaos
+	env JAX_PLATFORMS=cpu LGBM_TPU_CHAOS_SEED=1234 $(PYTEST) \
+	    tests/test_watchdog.py tests/test_supervisor.py
+
+# Measured recovery bench (docs/Fault-Tolerance.md): supervised kill -9 +
+# corrupt-latest against a fault-free baseline — reports MTTR, restart
+# count, total disruption, bit-identity, and the robustness layer's
+# steady-state overhead. Bank with LGBM_TPU_CHAOS_OUT=CHAOS_r<N>.json.
+bench-chaos:
+	python bench.py --chaos
 
 check-fast:
 	$(PYTEST) tests/test_parallel.py tests/test_wave_parity.py \
@@ -88,5 +108,5 @@ trace:
 	env LGBM_TPU_TELEMETRY_DIR=$(CURDIR)/.telemetry python bench.py --smoke
 	@echo "trace: $$(ls -1t .telemetry/trace_*.json | head -1)"
 
-.PHONY: lint verify check-fast check capi bench-cpu chaos trace bench-diff \
-        ledger multichip stream
+.PHONY: lint verify check-fast check capi bench-cpu chaos bench-chaos \
+        trace bench-diff ledger multichip stream

@@ -9,6 +9,7 @@ from .import_exec import ImportExecRule            # R006
 from .sort_in_loop import SortInLoopRule           # R007
 from .ad_hoc_timing import AdHocTimingRule         # R008
 from .device_transfer import DeviceTransferRule    # R009
+from .swallowed_exceptions import SwallowedExceptionRule  # R010
 
 _RULES = None
 
@@ -18,5 +19,6 @@ def active_rules():
     if _RULES is None:
         _RULES = [ControlFlowRule(), HostSyncRule(), DtypePromotionRule(),
                   PallasShapeRule(), StaticArgsRule(), ImportExecRule(),
-                  SortInLoopRule(), AdHocTimingRule(), DeviceTransferRule()]
+                  SortInLoopRule(), AdHocTimingRule(), DeviceTransferRule(),
+                  SwallowedExceptionRule()]
     return _RULES

@@ -430,8 +430,14 @@ class GBDT:
                 Xb, n_rows_padded=Npad, num_cols=cols_pad,
                 local_shard_rows=local_rd, n_devices=shard_devs,
                 code_mode=code_mode_for(int(_max_code), Xb.dtype))
+            # chaos hook (robustness/chaos.py): a marker-gated one-shot
+            # bit flip right after packing, so the per-shard CRC path is
+            # exercisable end-to-end; no-op without the env knob
+            from ..robustness.chaos import maybe_corrupt_shard_from_env
+            maybe_corrupt_shard_from_env(self._stream_store)
             self._stream = ShardPrefetcher(
-                self._stream_store, lambda a: self._put(a, "rows0"))
+                self._stream_store, lambda a: self._put(a, "rows0"),
+                verify=config.tpu_stream_verify)
             self.Xb = None
             sd = self._stream_store.describe()
             Log.info(

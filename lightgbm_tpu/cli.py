@@ -165,13 +165,28 @@ def run_train(params: Dict) -> None:
         _snapshot.order = 30
         callbacks.append(_snapshot)
     try:
-        booster = train_fn(params, train_set,
-                           num_boost_round=config.num_iterations,
-                           valid_sets=valid_sets, valid_names=valid_names,
-                           init_model=config.input_model or None,
-                           early_stopping_rounds=config.early_stopping_round
-                           or None,
-                           callbacks=callbacks)
+        try:
+            booster = train_fn(params, train_set,
+                               num_boost_round=config.num_iterations,
+                               valid_sets=valid_sets, valid_names=valid_names,
+                               init_model=config.input_model or None,
+                               early_stopping_rounds=(
+                                   config.early_stopping_round or None),
+                               callbacks=callbacks)
+        except Exception as e:
+            # stream-shard corruption is a RESTARTABLE fault: the host
+            # shard store is rebuilt from the dataset at construction, so
+            # exit with the typed status the supervisor recognizes
+            # (docs/Fault-Tolerance.md) instead of a generic traceback
+            from .ops.stream import ShardCorruptionError
+            if isinstance(e, ShardCorruptionError):
+                from .robustness.supervisor import EXIT_SHARD_CORRUPT
+                Log.warning("stream-shard corruption detected: %s — "
+                            "exiting %d (a supervisor relaunch with "
+                            "resume_from=auto self-heals)", e,
+                            EXIT_SHARD_CORRUPT)
+                raise SystemExit(EXIT_SHARD_CORRUPT) from e
+            raise
     finally:
         if saved_handlers:
             # past the training loop nothing checks stop_signals — restore

@@ -406,6 +406,22 @@ class Config:
     # non-finite gradient/hessian/leaf-output guard compiled into the
     # training step: none (off) | raise | skip_iter | clip
     nan_policy: str = "none"
+    # --- self-healing (robustness/watchdog.py, robustness/supervisor.py) ----
+    # hang watchdog: fire when no dispatch boundary is seen for
+    # max(hang_timeout_s, hang_median_factor * trailing-median iteration
+    # time). 0 = watchdog off (the default).
+    hang_timeout_s: float = 0.0
+    # adaptive multiple of the trailing median iteration time (0 = fixed
+    # hang_timeout_s only)
+    hang_median_factor: float = 8.0
+    # on firing: "dump" writes the diagnostic snapshot (thread stacks +
+    # observability.snapshot()) and keeps waiting; "abort" additionally
+    # exits 142 so a supervisor restarts from the last checkpoint
+    hang_action: str = "dump"
+    # verify each host code shard's CRC32 before its H2D transfer under
+    # tpu_residency=stream (ops/stream.py); detected corruption raises
+    # ShardCorruptionError (CLI exit 144) instead of training on rot
+    tpu_stream_verify: bool = True
 
     def __post_init__(self):
         self._check()
@@ -503,6 +519,14 @@ class Config:
         if self.checkpoint_interval > 0 and not self.checkpoint_dir:
             Log.fatal("checkpoint_interval=%d needs checkpoint_dir to be set",
                       self.checkpoint_interval)
+        if self.hang_timeout_s < 0:
+            Log.fatal("hang_timeout_s must be >= 0 (0 = watchdog off), "
+                      "got %g", self.hang_timeout_s)
+        if self.hang_median_factor < 0:
+            Log.fatal("hang_median_factor must be >= 0 (0 = fixed timeout "
+                      "only), got %g", self.hang_median_factor)
+        if self.hang_action not in ("dump", "abort"):
+            Log.fatal("Unknown hang_action %s (dump|abort)", self.hang_action)
         if self.tpu_profile_iters:
             from .observability.profiler import parse_profile_iters
             try:

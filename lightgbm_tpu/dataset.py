@@ -309,8 +309,12 @@ def _find_bins(active: List[int], find_one,
         # all ranks must have READ every shard before any key disappears
         client.wait_at_barrier(f"lgbm_binmappers_done/{seq}", timeout_ms)
         client.key_value_delete(f"lgbm_binmappers/{seq}/{rank}")
-    except Exception:
-        pass                         # best-effort server-side cleanup
+    except Exception as e:                                   # noqa: BLE001
+        # best-effort server-side cleanup: the gather already succeeded,
+        # the key just lives until TTL — but the fault is LOGGED (R010),
+        # never silently eaten
+        Log.debug("binmapper KV cleanup failed (key left for TTL expiry): "
+                  "%s: %s", type(e).__name__, e)
     return out
 
 

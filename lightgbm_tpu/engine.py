@@ -166,9 +166,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
                       "checkpoint already contains the full training state")
         resolved = resume_from
         if resume_from == "auto":
+            # lineage fallback (robustness/checkpoint.py): walk BACK to the
+            # newest snapshot that passes its integrity check, so a
+            # truncated/bit-flipped latest costs one checkpoint interval
+            # instead of killing the resume (docs/Fault-Tolerance.md)
             from .robustness.checkpoint import CheckpointManager
-            resolved = (CheckpointManager(config.checkpoint_dir).latest()
-                        if config.checkpoint_dir else None)
+            resolved = (CheckpointManager(
+                config.checkpoint_dir).latest_verified()
+                if config.checkpoint_dir else None)
             if resolved is None:
                 Log.info("resume_from=auto: no checkpoint under %r — "
                          "starting fresh", config.checkpoint_dir)
@@ -181,6 +186,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
                             start_iter, n_rounds)
 
     callbacks = list(callbacks or [])
+    # chaos hang injection (robustness/chaos.py): env-gated one-shot
+    # callback that wedges the loop where the watchdog heartbeat goes
+    # quiet — a no-op without LGBM_TPU_CHAOS_HANG
+    from .robustness.chaos import maybe_hang_callback
+    _hang_cb = maybe_hang_callback()
+    if _hang_cb is not None:
+        callbacks.append(_hang_cb)
     if config.checkpoint_dir and config.checkpoint_interval > 0:
         # interval-CROSSING check, not modulo: under tree_batch>1 the
         # callback fires at batch boundaries whose iteration numbers jump
@@ -275,6 +287,25 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if config.tpu_cost_analysis:
         _costs_was_enabled = obs_costs.enabled()
         obs_costs.configure(enabled=True)
+    # ---- hang watchdog (robustness/watchdog.py) ----------------------------
+    # heartbeat-fed from the same host dispatch boundaries the span tracer
+    # records: one beat per batch dispatch below, zero device syncs. A
+    # wedged collective/transfer blocks the loop, the beats stop, and the
+    # watchdog dumps diagnostics (hang_action=abort additionally exits 142
+    # so the supervisor restarts from the last checkpoint).
+    watchdog = None
+    if config.hang_timeout_s > 0:
+        from .robustness.watchdog import HangWatchdog
+        watchdog = HangWatchdog(
+            timeout_s=config.hang_timeout_s,
+            median_factor=config.hang_median_factor,
+            action=config.hang_action,
+            dump_dir=(obs.telemetry_dir() or config.checkpoint_dir or "."))
+        watchdog.beat(start_iter)
+        watchdog.start()
+        Log.info("hang watchdog armed: timeout %.1fs, median factor %g, "
+                 "action=%s", config.hang_timeout_s,
+                 config.hang_median_factor, config.hang_action)
     try:
         with maybe_xla_trace(whole_run_profile), \
                 obs.span("train", rows=gbdt.num_data, n_rounds=n_rounds,
@@ -292,6 +323,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     gbdt.train_batch(k)
                 it_end = it + k
                 profile_window.after_step(it_end)
+                if watchdog is not None:
+                    watchdog.beat(it_end)
                 eval_results = []
                 if gbdt.valid_sets or gbdt.config.is_training_metric:
                     # eval when the batch crossed a metric_freq boundary
@@ -310,6 +343,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
         best_iteration = e.best_iteration + 1
         booster.best_score = e.best_score
     finally:
+        if watchdog is not None:
+            watchdog.stop()
         profile_window.close()
         # telemetry finalize + flush must never take the run down — and must
         # run on EVERY exit path (early stop, nan_policy=raise, comm errors)

@@ -287,8 +287,12 @@ def capture_jit(site: str, fn, args: Tuple = (), kwargs: Optional[Dict] = None,
                       error=f"{type(e).__name__}: {e}"[:300])
     try:
         publish(report)
-    except Exception:                                        # noqa: BLE001
-        pass
+    except Exception as e:                                   # noqa: BLE001
+        # never-raises contract — but a failed publish is logged (R010),
+        # not silently dropped: the report still returns to the caller
+        from ..utils.log import Log
+        Log.debug("cost report publish failed for %s: %s: %s",
+                  site, type(e).__name__, e)
     return report
 
 

@@ -203,8 +203,10 @@ def hbm_preflight(gbdt) -> Dict:
     cache_cols = hist_cols
     try:
         cache_cols = int(gbdt.comm.reduced_hist_features(hist_cols))
-    except Exception:                                        # noqa: BLE001
-        pass
+    except Exception as e:                                   # noqa: BLE001
+        from ..utils.log import Log
+        Log.debug("hbm_preflight: reduced_hist_features unavailable "
+                  "(using %d): %s: %s", cache_cols, type(e).__name__, e)
     if spec.hist_f64:
         channels, channel_bytes = 3, 4
     elif spec.hist_hilo:

@@ -28,6 +28,17 @@ device-resident and move ONLY the compressed bin codes. Three pieces:
   donated to jitted fns (the same buffer is handed out again next wave),
   which is what makes the ping-pong donation-safe.
 
+Integrity: each packed shard carries a CRC32 taken at pack time, re-checked
+before EVERY transfer (``tpu_stream_verify``, on by default). A mismatch
+raises the typed :class:`ShardCorruptionError` instead of folding
+bit-rotted codes into histograms; the chaos harness (robustness/chaos.py
+``corrupt_host_shard``) flips shard bytes in flight to exercise exactly
+this path. The check is NOT free: zlib.crc32 runs ~1 GB/s on one host
+core — the same order as the copy it precedes — and it is synchronous in
+the training thread, so at host-RAM-scale stores it is a measurable tax
+(``bench.py --stream`` prices it on the real shape); set
+``tpu_stream_verify=false`` to trade detection for that throughput.
+
 This module and ``dataset.py`` are the only sanctioned homes of
 ``jax.device_put`` reachable from wave/scan bodies — tpu-lint R009
 enforces that the prefetcher stays the single choke point for mid-loop
@@ -35,6 +46,7 @@ host->device traffic.
 """
 from __future__ import annotations
 
+import zlib
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -42,7 +54,14 @@ import numpy as np
 from ..utils.log import Log
 
 
-# --------------------------------------------------------- host-side packing
+class ShardCorruptionError(RuntimeError):
+    """A host-resident code shard failed its CRC32 integrity check at
+    transfer time: the bytes about to be fed to the histogram fold are not
+    the bytes that were packed (host memory corruption, a stray writer).
+    Training must stop — a silently corrupted shard poisons every later
+    tree. The store is rebuilt from the dataset at construction, so a
+    restart (the crash supervisor relaunches with ``resume_from=auto``)
+    self-heals; the CLI exits with status 144 on this error."""
 
 def pack_codes_host(X: np.ndarray, code_mode: str) -> np.ndarray:
     """[N, F] uint8/uint16 bin codes -> [N, code_bytes_total(F, mode)] u8.
@@ -154,9 +173,25 @@ class HostShardStore:
                               d * per_dev + (i + 1) * R)
                  for d in range(n_devices)]) if n_devices > 1 \
                 else padded_block(i * R, (i + 1) * R)
-            shards.append(pack_codes_host(block, code_mode))
+            shards.append(np.ascontiguousarray(
+                pack_codes_host(block, code_mode)))
         self.shards = shards
         self.shard_bytes = int(shards[0].nbytes) if shards else 0
+        # per-shard content checksum, taken at pack time: the prefetcher
+        # re-hashes each shard before every H2D transfer, so a bit flipped
+        # in host RAM between packing and streaming is DETECTED (typed
+        # ShardCorruptionError) instead of silently folded into histograms
+        self.checksums: List[int] = [self._crc(s) for s in shards]
+
+    @staticmethod
+    def _crc(shard: np.ndarray) -> int:
+        return zlib.crc32(shard) & 0xFFFFFFFF
+
+    def verify_shard(self, i: int) -> bool:
+        """Recompute shard ``i``'s CRC32 and compare with the pack-time
+        value. Costs ~shard_bytes / 1 GB/s of synchronous host CPU — see
+        the module docstring for the honest per-iteration price."""
+        return self._crc(self.shards[i]) == self.checksums[i]
 
     @property
     def total_bytes(self) -> int:
@@ -195,7 +230,8 @@ class ShardPrefetcher:
     """
 
     def __init__(self, store: HostShardStore, put_fn: Callable,
-                 prefetch_enabled: Optional[bool] = None):
+                 prefetch_enabled: Optional[bool] = None,
+                 verify: bool = True):
         import os
         self.store = store
         self.put_fn = put_fn
@@ -203,6 +239,7 @@ class ShardPrefetcher:
             prefetch_enabled = os.environ.get(
                 "LGBM_TPU_STREAM_NO_PREFETCH", "") not in ("1", "true")
         self.prefetch_enabled = prefetch_enabled
+        self.verify_enabled = verify
         self._pending: Dict[int, object] = {}
         self.stalls = 0
         self.hits = 0
@@ -214,6 +251,17 @@ class ShardPrefetcher:
         return obs
 
     def _put(self, i: int):
+        if self.verify_enabled and not self.store.verify_shard(i):
+            obs = self._registry()
+            obs.inc("fault.shard_corrupt")
+            obs.event("shard_corrupt", shard=i)
+            raise ShardCorruptionError(
+                f"host shard {i} failed its CRC32 integrity check "
+                f"(expected {self.store.checksums[i]:#010x}) — the packed "
+                f"codes changed in host memory since construction; "
+                f"restart the run (resume_from=auto rebuilds the shard "
+                f"store from the dataset; tpu_stream_verify=false disables "
+                f"this check)")
         self.bytes_h2d += self.store.shard_bytes
         self._registry().inc("stream.bytes_h2d", self.store.shard_bytes)
         return self.put_fn(self.store.shards[i])
@@ -263,4 +311,5 @@ class ShardPrefetcher:
                 "stalls": self.stalls, "prefetch_hits": self.hits,
                 "stall_seconds": round(self.stall_seconds, 6),
                 "bytes_h2d": self.bytes_h2d,
-                "prefetch_enabled": self.prefetch_enabled}
+                "prefetch_enabled": self.prefetch_enabled,
+                "verify_enabled": self.verify_enabled}

@@ -1,19 +1,31 @@
-"""Fault-tolerance subsystem: checkpoint/resume, comm retry, numeric guards,
+"""Fault-tolerance subsystem: checkpoint/resume with integrity + lineage
+fallback, crash supervision, hang detection, comm retry, numeric guards,
 and the chaos-injection harness (docs/Fault-Tolerance.md).
 
 Pod-scale boosting runs hit preemptions, flaky coordination-service KV
 exchanges, and numerically exploding objectives as a matter of course
 (the regime the GPU-scaling literature assumes away — arXiv:1806.11248,
-arXiv:2005.09148). The four modules here are the resilience layer:
+arXiv:2005.09148). The modules here close the self-healing loop
+(detect -> checkpoint -> restart -> verify):
 
-- ``checkpoint``  — atomic booster snapshots + resume (CheckpointManager).
+- ``checkpoint``  — atomic, CRC32-checksummed booster snapshots + resume;
+                    ``latest_verified`` walks back through the lineage past
+                    corrupt snapshots; ``python -m
+                    lightgbm_tpu.robustness.checkpoint --verify DIR``.
+- ``supervisor``  — relaunch a killed/wedged CLI train child with
+                    ``resume_from=auto`` under bounded restarts + backoff,
+                    recording restarts and measured recovery time (MTTR).
+- ``watchdog``    — heartbeat-fed hang/straggler detection at dispatch
+                    boundaries; dumps thread stacks + the observability
+                    snapshot, optionally aborts-to-checkpoint (exit 142).
 - ``retry``       — bounded retry with exponential backoff + jitter for the
                     coordination-service KV ops (parallel/comm.py).
 - ``numeric``     — non-finite gradient/hessian/leaf detection and the
                     ``nan_policy`` semantics (raise | skip_iter | clip).
 - ``chaos``       — deterministic fault injection (KV delays/drops, payload
-                    corruption, forced NaN gradients) so every degradation
-                    path is testable on the CPU harness (``make chaos``).
+                    corruption, forced NaN gradients, shard bit flips, hang
+                    injection) so every degradation path is testable on the
+                    CPU harness (``make chaos``).
 """
 from __future__ import annotations
 
@@ -38,21 +50,30 @@ def allowed_host_sync(reason: str):
     return deco
 
 
-from .checkpoint import CheckpointError, CheckpointManager, config_fingerprint  # noqa: E402
+from .checkpoint import (CheckpointError, CheckpointManager,  # noqa: E402
+                         config_fingerprint, verify_checkpoint)
 from .retry import CommRetryError, CommTimeoutError, retry_call  # noqa: E402
+from .supervisor import Supervisor  # noqa: E402
+from .watchdog import EXIT_HANG, HangWatchdog  # noqa: E402
 
 __all__ = [
     "allowed_host_sync",
     "CheckpointError", "CheckpointManager", "config_fingerprint",
+    "verify_checkpoint",
     "CommRetryError", "CommTimeoutError", "retry_call",
-    "NonFiniteError",
+    "Supervisor", "HangWatchdog", "EXIT_HANG",
+    "NonFiniteError", "ShardCorruptionError",
 ]
 
 
 def __getattr__(name):
     # NonFiniteError lives in .numeric, which imports jax.numpy — keep the
-    # package importable (and the lint CLI jax-free) unless it is asked for
+    # package importable (and the lint CLI jax-free) unless it is asked
+    # for; ShardCorruptionError lives with the stream transport it guards
     if name == "NonFiniteError":
         from .numeric import NonFiniteError
         return NonFiniteError
+    if name == "ShardCorruptionError":
+        from ..ops.stream import ShardCorruptionError
+        return ShardCorruptionError
     raise AttributeError(name)

@@ -213,6 +213,116 @@ def uninstall_kv_chaos() -> None:
     comm._client_wrapper = None
 
 
+# ----------------------------------------------------- shard/hang injection
+# One-shot, marker-file-gated faults for the SUPERVISED chaos arms: the
+# faulted child injects once (and touches the marker), the relaunched child
+# sees the marker and runs clean — so the supervisor's recovery can be
+# asserted bit-identical against a fault-free run.
+
+ENV_FLIP_SHARD = "LGBM_TPU_CHAOS_FLIP_SHARD"    # marker-file path
+ENV_HANG = "LGBM_TPU_CHAOS_HANG"                # "<iteration>:<seconds>"
+ENV_HANG_MARKER = "LGBM_TPU_CHAOS_HANG_MARKER"  # marker-file path
+
+
+def kill_after_checkpoints(proc, ckpt_dir: str, n: int = 2,
+                           timeout_s: float = 300.0, poll_s: float = 0.05):
+    """Background thread that SIGKILLs ``proc`` once ``ckpt_dir`` holds at
+    least ``n`` snapshots — the scripted 'preemption mid-run' used by every
+    supervised kill arm (tests/test_chaos.py and ``bench.py --chaos``
+    share this one implementation). Returns the started thread; it exits
+    quietly when the process finishes first or the deadline passes."""
+    import threading
+
+    from .checkpoint import CheckpointManager
+
+    def _killer():
+        mgr = CheckpointManager(ckpt_dir)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and proc.poll() is None:
+            if len(mgr.list_checkpoints()) >= n:
+                Log.debug("chaos: SIGKILLing pid %s at %d checkpoints",
+                          getattr(proc, "pid", "?"), n)
+                proc.kill()
+                return
+            time.sleep(poll_s)
+
+    t = threading.Thread(target=_killer, name="lgbm-chaos-killer",
+                         daemon=True)
+    t.start()
+    return t
+
+
+def corrupt_host_shard(store, shard_index: int = 0,
+                       seed: Optional[int] = None, n_bytes: int = 4) -> int:
+    """Bit-flip ``n_bytes`` of one packed shard of a ``HostShardStore`` in
+    place — the 'host RAM rotted under a live run' scenario the per-shard
+    CRC32 (ops/stream.py) exists to catch. Deterministic under ``seed``.
+    Returns the shard index."""
+    rng = random.Random(default_seed() if seed is None else seed)
+    flat = store.shards[shard_index].reshape(-1)
+    for _ in range(max(1, n_bytes)):
+        flat[rng.randrange(flat.size)] ^= 0xFF
+    Log.debug("chaos: bit-flipped %d byte(s) of host shard %d",
+              max(1, n_bytes), shard_index)
+    return shard_index
+
+
+def maybe_corrupt_shard_from_env(store) -> bool:
+    """Env-driven one-shot shard corruption for child processes:
+    ``LGBM_TPU_CHAOS_FLIP_SHARD=<marker-path>`` flips shard 0 right after
+    store construction unless the marker file already exists (and creates
+    it), so only the FIRST child of a supervised run is poisoned. Returns
+    True when the fault fired. Called by the booster after it builds its
+    ``HostShardStore``; a no-op without the env knob."""
+    marker = os.environ.get(ENV_FLIP_SHARD, "")
+    if not marker or os.path.exists(marker):
+        return False
+    with open(marker, "w") as fh:
+        fh.write("shard-corruption injected\n")
+    corrupt_host_shard(store)
+    Log.warning("chaos: injected stream-shard corruption (marker %s)",
+                marker)
+    return True
+
+
+def maybe_hang_callback():
+    """Env-driven one-shot hang injection for child processes:
+    ``LGBM_TPU_CHAOS_HANG=<iteration>:<seconds>`` returns an after-iteration
+    callback that sleeps ``seconds`` at the first boundary past
+    ``iteration`` — a stand-in for a wedged collective, parked where the
+    watchdog heartbeat goes quiet. ``LGBM_TPU_CHAOS_HANG_MARKER=<path>``
+    makes it one-shot across supervisor restarts. Returns None without the
+    env knob."""
+    spec = os.environ.get(ENV_HANG, "")
+    if not spec:
+        return None
+    try:
+        it_s, sec_s = spec.split(":", 1)
+        hang_iter, hang_seconds = int(it_s), float(sec_s)
+    except ValueError:
+        Log.warning("chaos: malformed %s=%r (want '<iteration>:<seconds>')"
+                    " — hang injection disabled", ENV_HANG, spec)
+        return None
+    marker = os.environ.get(ENV_HANG_MARKER, "")
+    state = {"fired": False}
+
+    def _hang(env):
+        if state["fired"] or env.iteration + 1 < hang_iter:
+            return
+        state["fired"] = True
+        if marker:
+            if os.path.exists(marker):
+                return
+            with open(marker, "w") as fh:
+                fh.write("hang injected\n")
+        Log.warning("chaos: injected %.1fs hang at iteration %d (the "
+                    "watchdog should fire)", hang_seconds, env.iteration + 1)
+        time.sleep(hang_seconds)
+
+    _hang.order = 90            # after every real callback: the boundary
+    return _hang                # work is done before the loop wedges
+
+
 # --------------------------------------------------------------- gradients
 
 def nan_gradient_fobj(bad_iters: Sequence[int], mode: str = "nan",

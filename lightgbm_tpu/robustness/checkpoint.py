@@ -8,11 +8,25 @@ Layout: one pickle file per snapshot inside ``checkpoint_dir``,
 
 with monotonically increasing checkpoint ids (the id is derived from the
 files already present, so a resumed process keeps counting where the killed
-one stopped). Writes are write-temp-then-``os.replace`` with an fsync in
-between: a preemption mid-write can never leave a truncated file behind
-that parses as a checkpoint — at worst an orphaned ``*.tmp.*`` that the
-next save sweeps up. ``keep_last_n`` prunes old snapshots after every
-successful save (0 keeps everything).
+one stopped). Writes are write-temp-then-``os.replace`` with an fsync of
+the file in between and an fsync of the DIRECTORY after the rename (the
+rename itself lives in the parent directory's metadata — without the
+directory fsync a crash right after ``os.replace`` can roll the rename
+back and lose the snapshot): a preemption mid-write can never leave a
+truncated file behind that parses as a checkpoint — at worst an orphaned
+``*.tmp.*`` that the next save sweeps up. ``keep_last_n`` prunes old
+snapshots after every successful save (0 keeps everything).
+
+Every snapshot is wrapped in an integrity envelope: an 8-byte magic, the
+CRC32 of the payload bytes, and the payload length, followed by the pickled
+payload. ``load`` verifies the checksum before unpickling, so a truncated
+or bit-flipped snapshot fails loudly instead of resuming silently-wrong
+state; ``latest_verified`` walks BACK through the lineage to the newest
+snapshot that verifies (the ``resume_from="auto"`` fallback — a corrupt
+latest costs one checkpoint interval, not the run). Files written before
+the envelope existed (bare pickles) still load, flagged as legacy.
+``python -m lightgbm_tpu.robustness.checkpoint --verify DIR`` audits a
+checkpoint directory from the shell (jax-free, safe on a live run).
 
 Each payload carries a **config fingerprint** — a SHA-256 over the
 training-semantics subset of the Config — and resume fails loudly when the
@@ -35,11 +49,20 @@ import json
 import os
 import pickle
 import re
+import struct
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.log import Log
 
 FORMAT_VERSION = 1
+
+# Integrity envelope (format 2 on disk; the payload schema is unchanged):
+#   magic(8) | crc32-of-payload(u32 LE) | payload-length(u64 LE) | payload
+# A pre-envelope snapshot is a bare pickle (first byte \x80) — still
+# readable, but carries no checksum to verify against.
+ENVELOPE_MAGIC = b"LGBMCKP2"
+_ENVELOPE = struct.Struct("<8sIQ")
 
 _FILE_RE = re.compile(r"^ckpt_(\d{10})\.pkl$")
 
@@ -70,6 +93,11 @@ VOLATILE_CONFIG_FIELDS = frozenset({
     # The one behavioral coupling (stream forces tpu_row_compact=false) is
     # covered by tpu_row_compact itself staying fingerprinted.
     "tpu_residency", "tpu_stream_shard_rows", "tpu_hbm_budget_bytes",
+    # self-healing knobs (robustness/watchdog.py, ops/stream.py CRC check):
+    # detection-and-recovery policy, never training math — a snapshot from
+    # a watchdog-aborted run resumes under any watchdog/verify settings
+    "hang_timeout_s", "hang_median_factor", "hang_action",
+    "tpu_stream_verify",
     # cluster wiring: the restarted pod gets fresh addresses/ports
     "machines", "machine_list_file", "local_listen_port", "time_out",
     # profiling/telemetry (observability/: spans, exporters, profiler window)
@@ -81,6 +109,23 @@ VOLATILE_CONFIG_FIELDS = frozenset({
 
 class CheckpointError(RuntimeError):
     """A checkpoint could not be written, located, parsed, or validated."""
+
+
+def _fsync_dir(directory: str) -> None:
+    """fsync a directory's metadata (renames/unlinks inside it). Best-effort
+    on platforms whose directories cannot be opened — logged, never raised:
+    the snapshot itself is already fsynced and atomic either way."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError as e:
+        Log.debug("cannot open %s for directory fsync: %s", directory, e)
+        return
+    try:
+        os.fsync(fd)
+    except OSError as e:
+        Log.debug("directory fsync failed for %s: %s", directory, e)
+    finally:
+        os.close(fd)
 
 
 def fingerprinted_config(config) -> Dict:
@@ -155,12 +200,21 @@ class CheckpointManager:
         try:
             with _obs.span("checkpoint", checkpoint_id=ckpt_id,
                            iteration=payload.get("iteration")):
+                raw = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+                header = _ENVELOPE.pack(ENVELOPE_MAGIC,
+                                        zlib.crc32(raw) & 0xFFFFFFFF,
+                                        len(raw))
                 with open(tmp, "wb") as fh:
-                    pickle.dump(payload, fh,
-                                protocol=pickle.HIGHEST_PROTOCOL)
+                    fh.write(header)
+                    fh.write(raw)
                     fh.flush()
                     os.fsync(fh.fileno())
                 os.replace(tmp, path)
+                # make the RENAME durable too: the new directory entry lives
+                # in the parent dir's metadata, which the file fsync above
+                # does not cover — a crash here must not resurrect the old
+                # directory state and lose the snapshot
+                _fsync_dir(self.directory)
         except OSError as e:
             _obs.inc("checkpoint.write_failures")
             try:
@@ -183,18 +237,29 @@ class CheckpointManager:
             except OSError as e:
                 Log.warning("cannot prune old checkpoint %s: %s", path, e)
 
-    def _sweep_tmp(self) -> None:
-        """Remove orphaned temp files from writers killed mid-snapshot."""
+    def _sweep_tmp(self) -> int:
+        """Remove orphaned temp files from writers killed mid-snapshot
+        (a ``kill -9`` during ``save`` leaves ``*.pkl.tmp.<pid>`` behind —
+        never a half-written ``ckpt_*.pkl``). Returns how many were swept;
+        the directory is fsynced after a sweep so the unlinks are durable."""
         try:
             names = os.listdir(self.directory)
         except OSError:
-            return
+            return 0
+        swept = 0
         for name in names:
             if ".pkl.tmp." in name:
                 try:
                     os.unlink(os.path.join(self.directory, name))
-                except OSError:
-                    pass
+                    swept += 1
+                except OSError as e:
+                    Log.debug("cannot sweep orphaned tmp %s: %s", name, e)
+        if swept:
+            Log.info("swept %d orphaned checkpoint tmp file(s) from %s "
+                     "(a previous writer was killed mid-snapshot)",
+                     swept, self.directory)
+            _fsync_dir(self.directory)
+        return swept
 
     # ------------------------------------------------------------- loading
 
@@ -211,16 +276,75 @@ class CheckpointManager:
             raise CheckpointError(f"checkpoint {path_or_dir} does not exist")
         return path_or_dir
 
+    def latest_verified(self) -> Optional[str]:
+        """The newest snapshot that passes :func:`verify_checkpoint`,
+        walking BACK through the lineage (``resume_from="auto"``): a
+        truncated or bit-flipped latest costs one checkpoint interval
+        instead of the run. Corrupt snapshots are skipped with a warning
+        (and counted as ``fault.checkpoint_corrupt``) but left on disk for
+        forensics. Returns None when the directory holds no snapshots at
+        all; raises when snapshots exist but NONE verifies — silently
+        retraining from scratch over an all-corrupt lineage is exactly the
+        surprise this walk exists to prevent."""
+        from .. import observability as _obs
+        cks = self.list_checkpoints()
+        for ckpt_id, path in reversed(cks):
+            ok, detail = verify_checkpoint(path)
+            if ok:
+                return path
+            _obs.inc("fault.checkpoint_corrupt")
+            Log.warning("checkpoint %s failed verification (%s) — falling "
+                        "back to the previous snapshot", path, detail)
+        if cks:
+            raise CheckpointError(
+                f"all {len(cks)} snapshot(s) in {self.directory} failed "
+                f"verification — refusing to silently retrain from scratch; "
+                f"inspect with `python -m lightgbm_tpu.robustness.checkpoint "
+                f"--verify {self.directory}` and delete the directory to "
+                f"start fresh deliberately")
+        return None
+
     @staticmethod
-    def load(path_or_dir: str) -> Dict:
-        """Load and schema-validate one snapshot (fails loudly on
-        truncation/corruption — a half-written pickle must never resume)."""
-        path = CheckpointManager.resolve(path_or_dir)
+    def _read_payload_bytes(path: str) -> Tuple[bytes, bool]:
+        """(payload bytes, had_envelope) — envelope parsed and CRC-verified
+        when present; a pre-envelope file returns its raw bytes."""
         try:
             with open(path, "rb") as fh:
-                payload = pickle.load(fh)
-        except (OSError, pickle.UnpicklingError, EOFError, AttributeError,
-                ImportError, IndexError, ValueError) as e:
+                data = fh.read()
+        except OSError as e:
+            raise CheckpointError(
+                f"cannot read checkpoint {path}: {e}") from e
+        if not data.startswith(ENVELOPE_MAGIC):
+            # legacy bare pickle (pre-integrity-envelope) — no checksum to
+            # check against; the pickle parse + schema checks still apply
+            Log.debug("checkpoint %s predates the integrity envelope "
+                      "(no checksum to verify)", path)
+            return data, False
+        if len(data) < _ENVELOPE.size:
+            raise CheckpointError(
+                f"{path} is shorter than its envelope header "
+                f"(corrupt or truncated snapshot?)")
+        _magic, crc, length = _ENVELOPE.unpack_from(data)
+        raw = data[_ENVELOPE.size:]
+        if len(raw) != length:
+            raise CheckpointError(
+                f"{path} payload is {len(raw)} bytes but the envelope "
+                f"records {length} (corrupt or truncated snapshot?)")
+        actual = zlib.crc32(raw) & 0xFFFFFFFF
+        if actual != crc:
+            raise CheckpointError(
+                f"{path} failed its integrity check: payload crc32 "
+                f"{actual:#010x} != recorded {crc:#010x} (corrupt or "
+                f"truncated snapshot? bit rot?)")
+        return raw, True
+
+    @staticmethod
+    def _validate_payload(raw: bytes, path: str) -> Dict:
+        """Unpickle + schema-validate already-CRC-verified payload bytes."""
+        try:
+            payload = pickle.loads(raw)
+        except (pickle.UnpicklingError, EOFError, AttributeError,
+                ImportError, IndexError, ValueError, MemoryError) as e:
             raise CheckpointError(
                 f"cannot load checkpoint {path}: {type(e).__name__}: {e} "
                 f"(corrupt or truncated snapshot?)") from e
@@ -236,3 +360,80 @@ class CheckpointManager:
                 raise CheckpointError(f"{path} is missing the {key!r} field "
                                       f"— corrupt snapshot?")
         return payload
+
+    @staticmethod
+    def load(path_or_dir: str) -> Dict:
+        """Load, checksum-verify, and schema-validate one snapshot (fails
+        loudly on truncation/corruption — a half-written or bit-flipped
+        pickle must never resume)."""
+        path = CheckpointManager.resolve(path_or_dir)
+        raw, _ = CheckpointManager._read_payload_bytes(path)
+        return CheckpointManager._validate_payload(raw, path)
+
+
+# ------------------------------------------------------------- verification
+
+def verify_checkpoint(path: str) -> Tuple[bool, str]:
+    """Full integrity check of one snapshot FILE: envelope checksum,
+    pickle parse, schema validation — one read of the file. Returns
+    ``(ok, detail)`` — never raises, so lineage walks and the ``--verify``
+    CLI can report every snapshot's state."""
+    try:
+        raw, had_envelope = CheckpointManager._read_payload_bytes(path)
+        payload = CheckpointManager._validate_payload(raw, path)
+    except CheckpointError as e:
+        return False, str(e)
+    detail = (f"iteration {payload.get('iteration')}, checkpoint_id "
+              f"{payload.get('checkpoint_id')}")
+    if not had_envelope:
+        detail += " [legacy: no checksum envelope]"
+    return True, detail
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m lightgbm_tpu.robustness.checkpoint --verify DIR|FILE``:
+    audit every snapshot's integrity from the shell (jax-free — safe to run
+    against a live training run's checkpoint directory).
+
+    Exit codes: 0 = every snapshot verifies; 1 = corrupt snapshot(s)
+    present but a verified resume target exists (named on stdout);
+    2 = no usable snapshot (none found, or all corrupt)."""
+    import argparse
+    import sys
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.robustness.checkpoint",
+        description="Verify checkpoint snapshot integrity "
+                    "(docs/Fault-Tolerance.md)")
+    ap.add_argument("--verify", required=True, metavar="DIR_OR_FILE",
+                    help="checkpoint directory (or one snapshot file)")
+    args = ap.parse_args(argv)
+
+    target = args.verify
+    if os.path.isfile(target):
+        entries = [(None, target)]
+    else:
+        entries = CheckpointManager(target).list_checkpoints() \
+            if os.path.isdir(target) else []
+        if not entries:
+            print(f"no checkpoints (ckpt_*.pkl) found under {target}",
+                  file=sys.stderr)
+            return 2
+    newest_ok, n_bad = None, 0
+    for _ckpt_id, path in entries:
+        ok, detail = verify_checkpoint(path)
+        print(f"{os.path.basename(path):<24} "
+              f"{'OK     ' if ok else 'CORRUPT'}  {detail}")
+        if ok:
+            newest_ok = path
+        else:
+            n_bad += 1
+    if newest_ok is None:
+        print("no verified snapshot — nothing to resume from",
+              file=sys.stderr)
+        return 2
+    print(f"resume target: {newest_ok}")
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,8 +12,15 @@ variables so operators can match it to their cluster's restart behavior:
 - ``LGBM_TPU_COMM_BACKOFF_BASE``   first delay, seconds (default 0.5)
 - ``LGBM_TPU_COMM_BACKOFF_MAX``    delay ceiling, seconds (default 30)
 - ``LGBM_TPU_COMM_BACKOFF_JITTER`` jitter fraction on top (default 0.25)
+- ``LGBM_TPU_COMM_JITTER_SEED``    seed the jitter RNG (chaos runs replay
+                                   the exact backoff schedule; unset =
+                                   process-global randomness)
 
-Deterministic tests pass an explicitly seeded ``rng`` and a fake ``sleep``.
+The terminal failure names the operation AND the cost of trying: the
+attempt count and the cumulative backoff wall-clock ride in both the final
+warning and the raised ``CommRetryError``, so a post-mortem shows how long
+was burned retrying before the run died. Deterministic tests pass an
+explicitly seeded ``rng`` and a fake ``sleep`` (or set the seed env knob).
 """
 from __future__ import annotations
 
@@ -79,10 +86,25 @@ def retry_call(fn: Callable, *, what: str,
         _env_float("LGBM_TPU_COMM_BACKOFF_MAX", 30.0)
     jit = jitter if jitter is not None else \
         _env_float("LGBM_TPU_COMM_BACKOFF_JITTER", 0.25)
-    rng = rng if rng is not None else random
+    if rng is None:
+        # seedable jitter: with LGBM_TPU_COMM_JITTER_SEED set (the chaos
+        # harness pins it) every retry_call draws the identical backoff
+        # schedule, so a failing chaos run replays bit-for-bit. A
+        # malformed seed is WARNED about, never silently ignored — the
+        # operator asked for replayability and would not get it
+        seed = os.environ.get("LGBM_TPU_COMM_JITTER_SEED")
+        rng = random
+        if seed:
+            try:
+                rng = random.Random(int(seed))
+            except ValueError:
+                Log.warning("LGBM_TPU_COMM_JITTER_SEED=%r is not an "
+                            "integer; backoff jitter is UNSEEDED (this "
+                            "run will not replay exactly)", seed)
     from ..observability import get_registry
     reg = get_registry()
     last: Optional[BaseException] = None
+    total_wait = 0.0
     for attempt in range(attempts):
         try:
             return fn()
@@ -92,6 +114,7 @@ def retry_call(fn: Callable, *, what: str,
                 break
             delay = min(base * (2.0 ** attempt), ceil)
             delay *= 1.0 + jit * rng.random()
+            total_wait += delay
             # telemetry: every retry is counted (the JSONL stream carries
             # the counter snapshot; the warning below carries the story)
             reg.counter("comm.retries").inc()
@@ -100,6 +123,12 @@ def retry_call(fn: Callable, *, what: str,
                         type(last).__name__, last, delay)
             sleep(delay)
     reg.counter("comm.failures").inc()
+    reg.histogram("comm.retry_wait_seconds").observe(total_wait)
+    # the terminal failure must not hide what the retrying COST: the
+    # attempt count and cumulative backoff ride in the log and the error
+    Log.warning("%s failed permanently: %d attempt(s), %.3fs cumulative "
+                "backoff (%s: %s)", what, attempts, total_wait,
+                type(last).__name__, last)
     raise CommRetryError(
-        f"{what} failed after {attempts} attempt(s): "
-        f"{type(last).__name__}: {last}") from last
+        f"{what} failed after {attempts} attempt(s) and {total_wait:.3f}s "
+        f"of backoff: {type(last).__name__}: {last}") from last

@@ -1,0 +1,259 @@
+"""Hang/straggler watchdog: detect a wedged training loop, dump why, and
+optionally abort back to the last checkpoint (docs/Fault-Tolerance.md).
+
+On preemptible pods the second-worst failure after a killed process is a
+*wedged* one — a collective waiting on a peer that will never answer, a
+stuck H2D transfer — which burns wall-clock forever without tripping any
+error path. The watchdog turns "wedged" into a bounded, diagnosable event:
+
+- ``HangWatchdog.beat(iteration)`` is called at the host dispatch
+  boundaries the span tracer records (engine.train's batch loop — one beat
+  per jit dispatch, zero device syncs). The intervals between beats feed a
+  trailing-median estimate of the normal iteration time.
+- A monitor thread (or an explicit ``check()`` call — tests drive a fake
+  clock through it, no real sleeps) fires when the time since the last
+  beat exceeds ``max(hang_timeout_s, hang_median_factor * trailing
+  median)``: the fixed floor catches the cold start, the median multiple
+  adapts to the workload so a 50 ms/iter run is not given 300 s to wedge.
+- Firing dumps a diagnostic snapshot — every thread's stack plus
+  ``observability.snapshot()`` — to ``watchdog_dump_<pid>_<n>.json``
+  (telemetry dir > checkpoint dir > cwd), counts ``fault.hangs``, and
+  records a ``watchdog_dump`` span.
+- ``action="abort"`` then exits the process with :data:`EXIT_HANG` (142):
+  the crash supervisor (robustness/supervisor.py) sees a nonzero exit and
+  relaunches with ``resume_from=auto`` — abort-to-checkpoint. The wedged
+  dispatch cannot be cancelled from Python, so a clean in-process recovery
+  is not on the table; a bounded restart is.
+
+The clock is ``observability.clock()`` (monkeypatchable — the tier-1
+boundary tests run on a fake clock), read through the module at call time.
+"""
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import threading
+import traceback
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..utils.log import Log
+
+# exit status of an abort-to-checkpoint: distinct from SIGTERM's 143 (clean
+# checkpoint-then-exit) and from generic crashes, so the supervisor's log
+# names the failure class it is recovering from
+EXIT_HANG = 142
+
+
+class HangWatchdog:
+    """Heartbeat-fed hang detector over the training loop's dispatch
+    boundaries. Thread-safe: ``beat`` is called from the training thread,
+    ``check`` from the monitor thread (or a test)."""
+
+    def __init__(self, timeout_s: float,
+                 median_factor: float = 8.0,
+                 action: str = "dump",
+                 dump_dir: str = "",
+                 max_dumps: int = 3,
+                 poll_interval_s: Optional[float] = None,
+                 startup_grace_s: Optional[float] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 abort_fn: Optional[Callable[[], None]] = None):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        if action not in ("dump", "abort"):
+            raise ValueError(f"unknown watchdog action {action!r} "
+                             f"(dump|abort)")
+        self.timeout_s = float(timeout_s)
+        self.median_factor = float(median_factor)
+        # the FIRST interval after arming contains the train-step jit
+        # compile — minutes on a big program, with no dispatch boundary to
+        # beat from. Until one real interval has been observed the firing
+        # threshold is raised to this grace (else a tight hang_timeout_s
+        # aborts every fresh/resumed process mid-compile, and a supervisor
+        # restart loop never gets past compilation — seen live before this
+        # guard existed)
+        self.startup_grace_s = (max(300.0, self.timeout_s)
+                                if startup_grace_s is None
+                                else float(startup_grace_s))
+        self.action = action
+        self.dump_dir = dump_dir or "."
+        self.max_dumps = max_dumps
+        self.poll_interval_s = (poll_interval_s if poll_interval_s
+                                else min(1.0, self.timeout_s / 4.0))
+        self._clock = clock
+        self._abort_fn = abort_fn
+        self._lock = threading.Lock()
+        self._intervals: deque = deque(maxlen=32)
+        self._last_beat: Optional[float] = None
+        self._iteration: Optional[int] = None
+        self._fired = False          # one firing per stall; beat() re-arms
+        self.dumps: List[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- plumbing
+
+    def _now(self) -> float:
+        if self._clock is not None:
+            return self._clock()
+        from .. import observability as _obs
+        return _obs.clock()
+
+    # ------------------------------------------------------------ heartbeat
+
+    def beat(self, iteration: Optional[int] = None) -> None:
+        """Mark one live dispatch boundary. Re-arms the watchdog after a
+        firing (a stall that recovered on its own is over)."""
+        now = self._now()
+        with self._lock:
+            if self._last_beat is not None:
+                self._intervals.append(max(now - self._last_beat, 0.0))
+            self._last_beat = now
+            if iteration is not None:
+                self._iteration = iteration
+            self._fired = False
+
+    def threshold_s(self) -> float:
+        """Current firing threshold: the startup grace until the first
+        real interval lands (the compile window), then the fixed floor,
+        raised to ``median_factor`` trailing-median iteration times once
+        enough beats have been seen to estimate one."""
+        with self._lock:
+            intervals = list(self._intervals)
+        if not intervals:
+            return max(self.timeout_s, self.startup_grace_s)
+        if self.median_factor > 0 and len(intervals) >= 3:
+            return max(self.timeout_s,
+                       self.median_factor * statistics.median(intervals))
+        return self.timeout_s
+
+    # ------------------------------------------------------------ detection
+
+    def check(self, now: Optional[float] = None) -> bool:
+        """One detection pass; returns True iff a hang fired. The monitor
+        thread calls this on its poll cadence; tier-1 tests call it
+        directly with a controlled clock."""
+        with self._lock:
+            last, fired = self._last_beat, self._fired
+            iteration = self._iteration
+        if last is None or fired:
+            return False
+        now = self._now() if now is None else now
+        stalled_s = now - last
+        threshold = self.threshold_s()
+        if stalled_s <= threshold:
+            return False
+        with self._lock:
+            if self._fired:          # lost the race to another checker
+                return False
+            self._fired = True
+        self._on_hang(stalled_s, threshold, iteration)
+        return True
+
+    def _on_hang(self, stalled_s: float, threshold: float,
+                 iteration: Optional[int]) -> None:
+        from .. import observability as _obs
+        _obs.inc("fault.hangs")
+        _obs.get_registry().gauge("fault.last_hang_stall_seconds").set(
+            round(stalled_s, 3))
+        Log.warning(
+            "watchdog: no dispatch boundary for %.1fs (threshold %.1fs, "
+            "last iteration %s) — the training loop looks wedged "
+            "(hung collective? stuck transfer?)",
+            stalled_s, threshold, iteration)
+        path = None
+        if len(self.dumps) < self.max_dumps:
+            with _obs.span("watchdog_dump", stalled_s=round(stalled_s, 3),
+                           iteration=iteration):
+                path = self._dump(stalled_s, threshold, iteration)
+        if self.action == "abort":
+            self._abort(path)
+
+    def _dump(self, stalled_s: float, threshold: float,
+              iteration: Optional[int]) -> Optional[str]:
+        """Write the diagnostic snapshot: every thread's current stack plus
+        the full observability snapshot. Never raises — a failed dump must
+        not mask the hang handling itself."""
+        from .. import observability as _obs
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        stacks: Dict[str, List[str]] = {}
+        for tid, frame in frames.items():
+            label = f"{names.get(tid, 'unknown')} (tid {tid})"
+            stacks[label] = [ln.rstrip("\n") for ln in
+                             traceback.format_stack(frame)]
+        payload = {
+            "kind": "watchdog_hang_dump",
+            "pid": os.getpid(),
+            "iteration": iteration,
+            "stalled_seconds": round(stalled_s, 3),
+            "threshold_seconds": round(threshold, 3),
+            "action": self.action,
+            "thread_stacks": stacks,
+            "snapshot": _obs.snapshot(),
+        }
+        path = os.path.join(
+            self.dump_dir,
+            f"watchdog_dump_{os.getpid()}_{len(self.dumps)}.json")
+        try:
+            from ..observability.export import atomic_write_json
+            atomic_write_json(path, payload, indent=1)
+        except Exception as e:                               # noqa: BLE001
+            Log.warning("watchdog: cannot write diagnostic dump %s: %s: %s",
+                        path, type(e).__name__, e)
+            return None
+        self.dumps.append(path)
+        _obs.inc("fault.watchdog_dumps")
+        Log.warning("watchdog: diagnostic dump written to %s", path)
+        return path
+
+    def _abort(self, dump_path: Optional[str]) -> None:
+        from .. import observability as _obs
+        _obs.inc("fault.hang_aborts")
+        Log.warning(
+            "watchdog: aborting to the last checkpoint (exit %d) — restart "
+            "with resume_from=auto, or run under "
+            "`python -m lightgbm_tpu.robustness.supervisor` which does so "
+            "automatically%s", EXIT_HANG,
+            f" (diagnostics: {dump_path})" if dump_path else "")
+        try:
+            _obs.flush()
+        except Exception as e:                               # noqa: BLE001
+            Log.warning("watchdog: telemetry flush on abort failed: %s: %s",
+                        type(e).__name__, e)
+        if self._abort_fn is not None:
+            self._abort_fn()
+            return
+        # the wedged dispatch holds arbitrary locks (XLA runtime, jax
+        # internals): a normal exit path can deadlock behind it, so leave
+        # without running interpreter teardown — the atomic checkpoint on
+        # disk is the state that matters
+        os._exit(EXIT_HANG)
+
+    # -------------------------------------------------------------- monitor
+
+    def start(self) -> "HangWatchdog":
+        """Start the daemon monitor thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._monitor_loop, name="lgbm-tpu-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.check()
+            except Exception as e:                           # noqa: BLE001
+                Log.warning("watchdog check failed: %s: %s",
+                            type(e).__name__, e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -74,8 +74,13 @@ def enable_compile_cache(cache_dir: str, min_compile_secs: float = 0.5) -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           min_compile_secs)
-    except Exception:
-        pass
+    except Exception as e:                                   # noqa: BLE001
+        # a jax without these config names just runs uncached — but that
+        # downgrade is logged (R010), not silent
+        from .log import Log
+        Log.debug("persistent compile cache unavailable on this jax "
+                  "(%s: %s) — compiles will not be cached",
+                  type(e).__name__, e)
 
 
 def maybe_enable_compile_cache(default_dir: str = "") -> str:

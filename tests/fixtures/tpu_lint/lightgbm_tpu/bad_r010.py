@@ -1,0 +1,46 @@
+"""Fixture: violates exactly R010 — broad exception handlers whose bodies
+only pass/continue, swallowing every failure class (the anti-pattern that
+starves the self-healing layer of the faults it exists to detect)."""
+
+
+def swallow_everything(items):
+    out = []
+    for it in items:
+        try:
+            out.append(int(it))
+        except Exception:                       # R010: broad + silent
+            pass
+    return out
+
+
+def bare_except_and_continue(items):
+    out = []
+    for it in items:
+        try:
+            out.append(1.0 / it)
+        except:                                 # noqa: E722  R010: bare
+            continue
+    return out
+
+
+def tuple_with_broad(fn):
+    try:
+        return fn()
+    except (ValueError, Exception):             # R010: tuple hides a broad
+        pass
+
+
+def narrow_is_fine(path):
+    import os
+    try:
+        os.unlink(path)                         # clean: narrow + bounded
+    except OSError:
+        pass
+
+
+def broad_but_logged(fn, log):
+    try:
+        return fn()
+    except Exception as e:                      # clean: the fault is seen
+        log.warning("fn failed: %s", e)
+        return None

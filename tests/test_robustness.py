@@ -20,11 +20,13 @@ from lightgbm_tpu.robustness.chaos import (ChaosKVClient, ChaosPlan,
                                            FakeKVStore, corrupt_payload,
                                            install_kv_chaos,
                                            uninstall_kv_chaos)
-from lightgbm_tpu.robustness.checkpoint import (CheckpointError,
+from lightgbm_tpu.robustness.checkpoint import (ENVELOPE_MAGIC,
+                                                CheckpointError,
                                                 CheckpointManager,
                                                 config_fingerprint,
                                                 config_mismatch_fields,
-                                                fingerprinted_config)
+                                                fingerprinted_config,
+                                                verify_checkpoint)
 from lightgbm_tpu.robustness.retry import (CommRetryError, CommTimeoutError,
                                            retry_call)
 
@@ -86,6 +88,44 @@ def test_backoff_jitter_is_bounded_and_seeded():
     d1, d2 = run(), run()
     assert d1 == d2                           # seeded = reproducible
     assert 1.0 <= d1[0] <= 1.5 and 2.0 <= d1[1] <= 3.0
+
+
+def test_terminal_failure_reports_attempts_and_cumulative_wait(caplog):
+    """The final CommRetryError (and the last warning) must carry how much
+    wall-clock the retrying burned — the post-mortem number the terminal
+    error used to hide."""
+    with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"), \
+            pytest.raises(CommRetryError,
+                          match=r"4 attempt\(s\) and 7\.000s of backoff"):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("down")),
+                   what="doomed", attempts=4, base_delay=1.0, max_delay=4.0,
+                   jitter=0.0, sleep=lambda d: None, rng=random.Random(0))
+    finals = [r for r in caplog.records
+              if "failed permanently" in r.getMessage()]
+    assert len(finals) == 1
+    assert "4 attempt(s)" in finals[0].getMessage()
+    assert "7.000s cumulative backoff" in finals[0].getMessage()
+
+
+def test_jitter_seed_env_makes_backoff_deterministic(monkeypatch):
+    """LGBM_TPU_COMM_JITTER_SEED pins the jitter RNG so chaos runs replay
+    the exact backoff schedule without threading an rng through call
+    sites."""
+    monkeypatch.setenv("LGBM_TPU_COMM_JITTER_SEED", "99")
+
+    def run():
+        delays = []
+        with pytest.raises(CommRetryError):
+            retry_call(lambda: (_ for _ in ()).throw(OSError("x")),
+                       what="seeded", attempts=3, base_delay=1.0,
+                       max_delay=8.0, jitter=0.5, sleep=delays.append)
+        return delays
+
+    d1, d2 = run(), run()
+    assert d1 == d2 and len(d1) == 2
+    assert d1[0] != 1.0                     # jitter actually applied
+    monkeypatch.setenv("LGBM_TPU_COMM_JITTER_SEED", "100")
+    assert run() != d1                      # a different seed, different run
 
 
 def test_env_knobs_are_read_at_call_time(monkeypatch):
@@ -156,6 +196,123 @@ def test_non_checkpoint_and_missing_fields_rejected(tmp_path):
         CheckpointManager.resolve(str(empty))
     with pytest.raises(CheckpointError, match="does not exist"):
         CheckpointManager.resolve(str(tmp_path / "missing.pkl"))
+
+
+def test_snapshot_carries_integrity_envelope(tmp_path):
+    path = CheckpointManager(str(tmp_path)).save(_payload(3))
+    raw = open(path, "rb").read()
+    assert raw.startswith(ENVELOPE_MAGIC)
+    ok, detail = verify_checkpoint(path)
+    assert ok and "iteration 3" in detail
+    assert CheckpointManager.load(path)["iteration"] == 3
+
+
+def test_bit_flip_anywhere_in_payload_is_detected(tmp_path):
+    """The CRC catches corruptions that still UNPICKLE — the case the old
+    parse-only validation could never see."""
+    path = CheckpointManager(str(tmp_path)).save(_payload(1))
+    raw = bytearray(open(path, "rb").read())
+    raw[-1] ^= 0x01                       # one bit, last byte
+    open(path, "wb").write(bytes(raw))
+    ok, detail = verify_checkpoint(path)
+    assert not ok and "crc32" in detail
+    with pytest.raises(CheckpointError, match="integrity check"):
+        CheckpointManager.load(path)
+
+
+def test_legacy_pre_envelope_snapshot_still_loads(tmp_path):
+    p = tmp_path / "ckpt_0000000001.pkl"
+    p.write_bytes(pickle.dumps(dict(_payload(4), format_version=1)))
+    ok, detail = verify_checkpoint(str(p))
+    assert ok and "legacy" in detail
+    assert CheckpointManager.load(str(p))["iteration"] == 4
+
+
+def test_latest_verified_walks_back_past_corruption(tmp_path, caplog):
+    import logging
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=0)
+    paths = [mgr.save(_payload(i)) for i in range(3)]
+    # truncate the latest, bit-flip the middle: lineage falls back to #1
+    raw = open(paths[2], "rb").read()
+    open(paths[2], "wb").write(raw[: len(raw) // 2])
+    raw = bytearray(open(paths[1], "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(paths[1], "wb").write(bytes(raw))
+    with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+        assert mgr.latest_verified() == paths[0]
+    assert len([r for r in caplog.records
+                if "failed verification" in r.getMessage()]) == 2
+    from lightgbm_tpu import observability as obs
+    assert obs.snapshot()["counters"]["fault.checkpoint_corrupt"] >= 2
+    # corrupt snapshots stay on disk for forensics
+    assert len(mgr.list_checkpoints()) == 3
+
+
+def test_latest_verified_refuses_an_all_corrupt_lineage(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    path = mgr.save(_payload(0))
+    open(path, "wb").write(b"\x00" * 64)
+    with pytest.raises(CheckpointError, match="refusing to silently"):
+        mgr.latest_verified()
+
+
+def test_latest_verified_empty_dir_is_none(tmp_path):
+    assert CheckpointManager(str(tmp_path / "nope")).latest_verified() is None
+
+
+def test_kill9_during_save_leaves_only_a_tmp_and_next_save_sweeps(tmp_path):
+    """A real SIGKILL between the tmp-file fsync and the rename: the
+    directory must hold a *.pkl.tmp.* orphan and NO final snapshot; the
+    next save sweeps the orphan and the lineage stays clean."""
+    import subprocess
+    import sys
+    import textwrap
+    child = textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {repr(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))})
+        from lightgbm_tpu.robustness.checkpoint import CheckpointManager
+        def hang_replace(src, dst):
+            print("READY", flush=True)
+            time.sleep(60)
+        os.replace = hang_replace
+        CheckpointManager({repr(str(tmp_path))}).save(
+            {{"config_fingerprint": "f", "config": {{}}, "iteration": 0,
+              "state": {{}}}})
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", child],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.kill()                                   # SIGKILL, mid-save
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    names = os.listdir(tmp_path)
+    assert any(".pkl.tmp." in n for n in names)
+    assert not any(n.endswith(".pkl") for n in names)
+    mgr = CheckpointManager(str(tmp_path))
+    path = mgr.save(_payload(1))
+    names = os.listdir(tmp_path)
+    assert not any(".pkl.tmp." in n for n in names)   # orphan swept
+    assert mgr.latest_verified() == path
+
+
+def test_verify_cli_reports_and_names_the_resume_target(tmp_path, capsys):
+    from lightgbm_tpu.robustness.checkpoint import main as verify_main
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=0)
+    good = mgr.save(_payload(0))
+    bad = mgr.save(_payload(1))
+    assert verify_main(["--verify", str(tmp_path)]) == 0   # all green
+    raw = bytearray(open(bad, "rb").read())
+    raw[-3] ^= 0xFF
+    open(bad, "wb").write(bytes(raw))
+    assert verify_main(["--verify", str(tmp_path)]) == 1   # fallback exists
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out and f"resume target: {good}" in out
+    open(good, "wb").write(b"junk")
+    assert verify_main(["--verify", str(tmp_path)]) == 2   # nothing usable
 
 
 def test_fingerprint_ignores_run_control_but_not_semantics():

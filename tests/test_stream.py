@@ -387,3 +387,78 @@ def test_stream_nan_policy_skip_iter():
     for _ in range(4):
         bst.update(fobj=fobj)
     assert len(bst._gbdt.models) == 3     # the poisoned iteration dropped
+
+
+# ----------------------------------------------------- shard integrity (CRC)
+
+def test_shard_checksums_verify_and_catch_bit_flips():
+    from lightgbm_tpu.ops.stream import HostShardStore
+    from lightgbm_tpu.robustness.chaos import corrupt_host_shard
+    rng = np.random.RandomState(3)
+    X = rng.randint(0, 16, size=(1024, 8)).astype(np.uint8)
+    store = HostShardStore(X, n_rows_padded=1024, num_cols=8,
+                           local_shard_rows=256, n_devices=1, code_mode="u4")
+    assert len(store.checksums) == store.n_shards == 4
+    assert all(store.verify_shard(i) for i in range(store.n_shards))
+    idx = corrupt_host_shard(store, shard_index=2, seed=11)
+    assert idx == 2
+    assert not store.verify_shard(2)
+    assert all(store.verify_shard(i) for i in (0, 1, 3))
+
+
+def test_prefetcher_raises_typed_error_on_corrupt_shard():
+    """A corrupted shard must surface as ShardCorruptionError on its NEXT
+    transfer (prefetch or stall path alike), counted as
+    fault.shard_corrupt — never silently handed to the device."""
+    from lightgbm_tpu import observability as obs
+    from lightgbm_tpu.ops.stream import (HostShardStore, ShardPrefetcher,
+                                         ShardCorruptionError)
+    from lightgbm_tpu.robustness.chaos import corrupt_host_shard
+    obs.reset_for_tests()
+    rng = np.random.RandomState(4)
+    X = rng.randint(0, 250, size=(512, 4)).astype(np.uint8)
+    store = HostShardStore(X, n_rows_padded=512, num_cols=4,
+                           local_shard_rows=128, n_devices=1, code_mode="u8")
+    pf = ShardPrefetcher(store, put_fn=lambda a: a, prefetch_enabled=True)
+    assert pf.verify_enabled
+    pf.prefetch(0)
+    assert pf.get(0) is not None              # clean shard flows through
+    corrupt_host_shard(store, shard_index=1, seed=5)
+    with pytest.raises(ShardCorruptionError, match="shard 1.*CRC32"):
+        pf.prefetch(1)
+    with pytest.raises(ShardCorruptionError):  # the stall path checks too
+        pf.get(1)
+    assert obs.snapshot()["counters"]["fault.shard_corrupt"] == 2
+    # verification can be disabled deliberately (tpu_stream_verify=false)
+    pf_off = ShardPrefetcher(store, put_fn=lambda a: a, verify=False)
+    assert pf_off.get(1) is not None
+    obs.reset_for_tests()
+
+
+def test_streamed_training_detects_in_flight_shard_corruption():
+    """End-to-end: corrupt one host shard of a LIVE streamed booster —
+    the next update must die with the typed error instead of folding the
+    rotted codes into histograms."""
+    from lightgbm_tpu.ops.stream import ShardCorruptionError
+    from lightgbm_tpu.robustness.chaos import corrupt_host_shard
+    X, y = _make_binary(n=2048)
+    p = dict(BASE, tpu_residency="stream", tpu_stream_shard_rows=256)
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.Booster(params=p, train_set=ds)
+    bst.update()
+    corrupt_host_shard(bst._gbdt._stream_store, shard_index=0, seed=7)
+    with pytest.raises(ShardCorruptionError):
+        bst.update()
+
+
+def test_stream_verify_knob_disables_the_check():
+    from lightgbm_tpu.robustness.chaos import corrupt_host_shard
+    X, y = _make_binary(n=1024)
+    p = dict(BASE, tpu_residency="stream", tpu_stream_shard_rows=256,
+             tpu_stream_verify=False)
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.Booster(params=p, train_set=ds)
+    assert bst._gbdt._stream.verify_enabled is False
+    corrupt_host_shard(bst._gbdt._stream_store, shard_index=0, seed=7)
+    bst.update()                              # rides on, by explicit choice
+    assert len(bst._gbdt.models) == 1

@@ -1,0 +1,159 @@
+"""Crash-supervisor unit tests (robustness/supervisor.py): restart policy,
+resume_from=auto injection, seeded backoff determinism, exit-code labeling,
+and MTTR measurement via new checkpoint ids — all on injected spawn/clock/
+sleep doubles (no real processes, no real time; tier-1 fast).
+"""
+import pytest
+
+from lightgbm_tpu import observability as obs
+from lightgbm_tpu.robustness.checkpoint import CheckpointManager
+from lightgbm_tpu.robustness.supervisor import (EXIT_SHARD_CORRUPT,
+                                                Supervisor, _train_args_dict,
+                                                describe_exit)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    obs.reset_for_tests()
+    yield
+    obs.reset_for_tests()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class FakeProc:
+    """Scripted child: returns None for `polls_alive` polls, then `rc`.
+    `on_poll(n)` lets a test mutate the world mid-run (write a
+    checkpoint, advance the clock)."""
+
+    def __init__(self, rc, polls_alive=0, on_poll=None):
+        self.rc = rc
+        self.polls_alive = polls_alive
+        self.on_poll = on_poll
+        self.polls = 0
+
+    def poll(self):
+        self.polls += 1
+        if self.on_poll:
+            self.on_poll(self.polls)
+        if self.polls <= self.polls_alive:
+            return None
+        return self.rc
+
+
+def _supervisor(procs, args, clock=None, **kw):
+    spawned = []
+
+    def spawn(argv):
+        spawned.append(list(argv))
+        return procs[len(spawned) - 1]
+
+    sleeps = []
+    sup = Supervisor(args, spawn_fn=spawn, sleep=sleeps.append,
+                     clock=clock or FakeClock(), poll_interval_s=0.0, **kw)
+    sup._spawned, sup._sleeps = spawned, sleeps   # test handles
+    return sup
+
+
+BASE_ARGS = ["config=train.conf", "checkpoint_dir=/ck",
+             "checkpoint_interval=2"]
+
+
+def test_clean_exit_needs_no_restart():
+    sup = _supervisor([FakeProc(0)], BASE_ARGS)
+    assert sup.run() == 0
+    assert sup.restarts == 0
+    assert sup._spawned == [BASE_ARGS]
+
+
+def test_restart_appends_resume_auto_exactly_once():
+    sup = _supervisor([FakeProc(-9), FakeProc(1), FakeProc(0)], BASE_ARGS,
+                      seed=3)
+    assert sup.run() == 0
+    assert sup.restarts == 2
+    assert sup._spawned[0] == BASE_ARGS
+    assert sup._spawned[1] == BASE_ARGS + ["resume_from=auto"]
+    assert sup._spawned[2] == BASE_ARGS + ["resume_from=auto"]
+    snap = obs.snapshot()["counters"]
+    assert snap["fault.restarts"] == 2
+    assert snap["fault.child_failures"] == 2
+
+
+def test_restart_budget_is_bounded_and_final_rc_returned():
+    sup = _supervisor([FakeProc(7)] * 3, BASE_ARGS, max_restarts=2, seed=0)
+    assert sup.run() == 7
+    assert sup.restarts == 2
+    assert len(sup._spawned) == 3          # initial + 2 restarts
+    assert sup.exit_codes == [7, 7, 7]
+
+
+def test_backoff_schedule_doubles_caps_and_replays_under_seed():
+    def run():
+        sup = _supervisor([FakeProc(1)] * 5, BASE_ARGS, max_restarts=4,
+                          backoff_base_s=1.0, backoff_max_s=4.0,
+                          jitter=0.25, seed=42)
+        sup.run()
+        return sup._sleeps
+
+    d1, d2 = run(), run()
+    assert d1 == d2                        # seeded jitter: exact replay
+    bases = [1.0, 2.0, 4.0, 4.0]           # 2**k then the ceiling
+    assert len(d1) == 4
+    for delay, base in zip(d1, bases):
+        assert base <= delay <= base * 1.25
+
+
+def test_mttr_measured_from_failure_to_next_checkpoint(tmp_path):
+    """The recovery clock starts at failure detection and stops the moment
+    the relaunched child banks a NEWER checkpoint id."""
+    clock = FakeClock()
+    ck = str(tmp_path)
+    mgr = CheckpointManager(ck, keep_last_n=0)
+    payload = {"config_fingerprint": "f", "config": {}, "iteration": 1,
+               "state": {}}
+    mgr.save(payload)                      # pre-failure lineage: id 1
+
+    def child2_poll(n):
+        clock.t += 10.0                    # each poll costs 10s
+        if n == 2:
+            mgr.save(payload)              # id 2: recovery point
+
+    procs = [FakeProc(-9), FakeProc(0, polls_alive=3, on_poll=child2_poll)]
+    sup = _supervisor(procs, [f"checkpoint_dir={ck}"], clock=clock, seed=1,
+                      backoff_base_s=0.0, jitter=0.0)
+    assert sup.run() == 0
+    assert len(sup.recovery_seconds) == 1
+    # fail at t0; polls 1..2 of the relaunched child advance 10s each and
+    # the checkpoint lands on poll 2 -> MTTR observed at 20s
+    assert sup.recovery_seconds[0] == pytest.approx(20.0)
+    hist = obs.snapshot()["histograms"]["fault.recovery_seconds"]
+    assert hist["count"] == 1 and hist["max"] == pytest.approx(20.0)
+
+
+def test_missing_checkpoint_dir_warns_but_supervises(caplog):
+    import logging
+    with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+        sup = _supervisor([FakeProc(0)], ["config=t.conf"])
+    assert any("FROM SCRATCH" in r.getMessage() for r in caplog.records)
+    assert sup.run() == 0
+
+
+def test_train_args_dict_normalizes_gnu_form():
+    d = _train_args_dict(["--checkpoint-dir=/x", "task=train",
+                          "--hang-timeout-s=5"])
+    assert d == {"checkpoint_dir": "/x", "task": "train",
+                 "hang_timeout_s": "5"}
+
+
+def test_describe_exit_labels_the_failure_classes():
+    assert "SIGKILL" in describe_exit(-9)
+    assert "hang" in describe_exit(142)
+    assert "SIGTERM" in describe_exit(143)
+    assert "corruption" in describe_exit(EXIT_SHARD_CORRUPT)
+    assert describe_exit(1) == "exit 1"

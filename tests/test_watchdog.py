@@ -1,0 +1,215 @@
+"""Hang-watchdog unit tests (robustness/watchdog.py): fires / does-not-fire
+boundary cases on a FAKE clock (no real sleeps, no monitor thread — the
+tier-1 contract from docs/Fault-Tolerance.md), the trailing-median adaptive
+threshold, the diagnostic dump contents, one-firing-per-stall re-arming,
+and the abort action (injected abort_fn — never os._exit in tests).
+"""
+import json
+import os
+
+import pytest
+
+from lightgbm_tpu import observability as obs
+from lightgbm_tpu.robustness.watchdog import EXIT_HANG, HangWatchdog
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    obs.reset_for_tests()
+    yield
+    obs.reset_for_tests()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _wd(clock, tmp_path, timeout=10.0, factor=0.0, action="dump", **kw):
+    kw.setdefault("startup_grace_s", 0.0)   # boundary tests probe the
+    return HangWatchdog(timeout_s=timeout,  # steady-state threshold
+                        median_factor=factor,
+                        action=action, dump_dir=str(tmp_path),
+                        clock=clock, **kw)
+
+
+# ------------------------------------------------------------ fire boundary
+
+def test_does_not_fire_without_any_beat(tmp_path):
+    clock = FakeClock()
+    wd = _wd(clock, tmp_path)
+    clock.advance(1e6)
+    assert wd.check() is False          # never armed: nothing is running
+
+
+def test_fires_strictly_past_the_fixed_timeout(tmp_path):
+    clock = FakeClock()
+    wd = _wd(clock, tmp_path, timeout=10.0)
+    wd.beat(0)
+    clock.advance(10.0)
+    assert wd.check() is False          # exactly AT the threshold: alive
+    clock.advance(0.001)
+    assert wd.check() is True           # past it: fired
+    assert obs.snapshot()["counters"]["fault.hangs"] == 1
+
+
+def test_fires_once_per_stall_and_rearms_on_beat(tmp_path):
+    clock = FakeClock()
+    wd = _wd(clock, tmp_path, timeout=1.0)
+    wd.beat(0)
+    clock.advance(5.0)
+    assert wd.check() is True
+    assert wd.check() is False          # same stall: one firing
+    wd.beat(1)                          # the loop came back: re-armed
+    clock.advance(0.5)
+    assert wd.check() is False
+    clock.advance(1.0)
+    assert wd.check() is True           # a NEW stall fires again
+    assert obs.snapshot()["counters"]["fault.hangs"] == 2
+
+
+def test_median_factor_raises_the_threshold(tmp_path):
+    """5 beats at 2s intervals -> trailing median 2s; factor 8 -> the
+    effective threshold is 16s even though the floor is 1s."""
+    clock = FakeClock()
+    wd = _wd(clock, tmp_path, timeout=1.0, factor=8.0)
+    for i in range(5):
+        wd.beat(i)
+        clock.advance(2.0)
+    assert wd.threshold_s() == pytest.approx(16.0)
+    # already 2s past the last beat; 10 more = 12s < 16s: no fire
+    clock.advance(10.0)
+    assert wd.check() is False
+    clock.advance(4.5)                  # 16.5s since the last beat
+    assert wd.check() is True
+
+
+def test_startup_grace_covers_the_first_dispatch_compile(tmp_path):
+    """Between arming and the FIRST real interval sits the train-step jit
+    compile (minutes on a big program, no boundary to beat from): the
+    threshold is the startup grace there, not the steady-state timeout —
+    a tight hang_timeout_s must not abort every fresh/resumed process
+    mid-compile (which would turn the supervisor into a restart loop
+    that never gets past compilation)."""
+    clock = FakeClock()
+    wd = HangWatchdog(timeout_s=1.0, median_factor=0.0, dump_dir=str(tmp_path),
+                      startup_grace_s=120.0, clock=clock)
+    wd.beat(0)                          # armed; zero intervals yet
+    assert wd.threshold_s() == pytest.approx(120.0)
+    clock.advance(60.0)                 # deep in the compile window
+    assert wd.check() is False
+    clock.advance(61.0)                 # a REAL hang outlives even grace
+    assert wd.check() is True
+    wd.beat(1)                          # first interval recorded: compile
+    clock.advance(0.5)                  # done, steady-state floor applies
+    wd.beat(2)
+    assert wd.threshold_s() == pytest.approx(1.0)
+    clock.advance(1.1)
+    assert wd.check() is True
+
+
+def test_startup_grace_defaults_to_at_least_300s(tmp_path):
+    wd = HangWatchdog(timeout_s=1.5, dump_dir=str(tmp_path))
+    assert wd.startup_grace_s == 300.0
+    wd2 = HangWatchdog(timeout_s=900.0, dump_dir=str(tmp_path))
+    assert wd2.startup_grace_s == 900.0
+
+
+def test_median_needs_three_intervals_before_it_applies(tmp_path):
+    clock = FakeClock()
+    wd = _wd(clock, tmp_path, timeout=5.0, factor=100.0)
+    wd.beat(0)
+    clock.advance(1.0)
+    wd.beat(1)                          # one interval: floor still rules
+    assert wd.threshold_s() == pytest.approx(5.0)
+    clock.advance(5.1)
+    assert wd.check() is True
+
+
+# ------------------------------------------------------------------- dumps
+
+def test_dump_contains_thread_stacks_and_snapshot(tmp_path):
+    clock = FakeClock()
+    wd = _wd(clock, tmp_path, timeout=1.0)
+    obs.get_registry().inc("fault.shard_corrupt")   # something to snapshot
+    wd.beat(7)
+    clock.advance(2.0)
+    assert wd.check() is True
+    assert len(wd.dumps) == 1
+    payload = json.load(open(wd.dumps[0]))
+    assert payload["kind"] == "watchdog_hang_dump"
+    assert payload["iteration"] == 7
+    assert payload["stalled_seconds"] == pytest.approx(2.0)
+    # this very test thread is in the stack dump, parked inside check()
+    stacks = payload["thread_stacks"]
+    assert stacks and any("check" in "".join(frames)
+                          for frames in stacks.values())
+    assert payload["snapshot"]["counters"]["fault.shard_corrupt"] == 1
+    assert obs.snapshot()["counters"]["fault.watchdog_dumps"] == 1
+
+
+def test_dump_count_is_bounded(tmp_path):
+    clock = FakeClock()
+    wd = _wd(clock, tmp_path, timeout=1.0, max_dumps=2)
+    for i in range(4):
+        wd.beat(i)
+        clock.advance(5.0)
+        assert wd.check() is True
+    assert len(wd.dumps) == 2
+    assert len([f for f in os.listdir(tmp_path)
+                if f.startswith("watchdog_dump_")]) == 2
+
+
+# ------------------------------------------------------------------- abort
+
+def test_abort_action_calls_abort_fn_after_dumping(tmp_path):
+    clock = FakeClock()
+    aborted = []
+    wd = _wd(clock, tmp_path, timeout=1.0, action="abort",
+             abort_fn=lambda: aborted.append(True))
+    wd.beat(0)
+    clock.advance(3.0)
+    assert wd.check() is True
+    assert aborted == [True]
+    assert wd.dumps                      # diagnostics land BEFORE the exit
+    assert obs.snapshot()["counters"]["fault.hang_aborts"] == 1
+    assert EXIT_HANG == 142              # the supervisor-visible contract
+
+
+def test_dump_action_does_not_abort(tmp_path):
+    clock = FakeClock()
+    aborted = []
+    wd = _wd(clock, tmp_path, timeout=1.0, action="dump",
+             abort_fn=lambda: aborted.append(True))
+    wd.beat(0)
+    clock.advance(3.0)
+    assert wd.check() is True
+    assert aborted == []
+
+
+# ------------------------------------------------------------- construction
+
+def test_rejects_bad_configuration(tmp_path):
+    with pytest.raises(ValueError, match="timeout_s"):
+        HangWatchdog(timeout_s=0.0)
+    with pytest.raises(ValueError, match="action"):
+        HangWatchdog(timeout_s=1.0, action="explode")
+
+
+def test_clock_defaults_to_observability_clock(tmp_path, monkeypatch):
+    """The satellite contract: tests drive the watchdog through a faked
+    observability.clock() — the watchdog must read it at call time."""
+    t = {"now": 100.0}
+    monkeypatch.setattr(obs, "clock", lambda: t["now"])
+    wd = HangWatchdog(timeout_s=1.0, dump_dir=str(tmp_path),
+                      startup_grace_s=0.0)
+    wd.beat(0)
+    t["now"] += 5.0
+    assert wd.check() is True
