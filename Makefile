@@ -10,7 +10,7 @@
 # compile cache in .jax_cache; `check-fast` is ~4 min cold.
 PYTEST := python -m pytest -q
 
-# Static JAX/TPU hygiene pass (rules R001-R010, see docs/Static-Analysis.md).
+# Static JAX/TPU hygiene pass (rules R001-R011, see docs/Static-Analysis.md).
 # Exits non-zero on any finding not covered by tpu_lint_baseline.json.
 lint:
 	python -m lightgbm_tpu.analysis lightgbm_tpu/
@@ -20,8 +20,9 @@ lint:
 # watchdog + checkpoint-checksum path adds 0 recompiles / 0 host syncs, and
 # pins the fused step's FLOPs/bytes to golden values) + the out-of-core
 # stream smoke (small N, forced budget -> tpu_residency=stream; asserts 0
-# recompiles and bit-identity with the resident output) + the perf-ledger
-# diff. The FAST chaos-matrix arms (corrupt-latest lineage fallback across
+# recompiles and bit-identity with the resident output) + the serving
+# smoke (protobuf -> ServingEngine bit-identity, 0 recompiles across the
+# bucket ladder under load) + the perf-ledger diff. The FAST chaos-matrix arms (corrupt-latest lineage fallback across
 # serial/data8/stream, watchdog fake-clock boundaries, shard-CRC
 # detection, supervisor policy) ride inside the tier-1 line — only the
 # slow supervised kill -9 / hang / shard-restart arms are deferred to
@@ -30,6 +31,7 @@ verify: lint
 	env JAX_PLATFORMS=cpu $(PYTEST) tests/ -m 'not slow'
 	python bench.py --smoke
 	$(MAKE) stream
+	$(MAKE) serve
 	$(MAKE) bench-diff
 
 # Out-of-core streaming smoke (docs/TPU-Performance.md "Out-of-core
@@ -41,6 +43,16 @@ verify: lint
 stream:
 	env LGBM_TPU_STREAM_ROWS=20000 LGBM_TPU_STREAM_ITERS=5 \
 	    python bench.py --stream
+
+# Serving smoke (docs/Serving.md): hermetic-CPU train -> protobuf ->
+# ServingEngine round trip asserting bit-identity with the training
+# booster's predict(), zero jit cache misses across closed + open
+# (Poisson/MicroBatcher) load after the AOT bucket warmup
+# (RecompileGuard), and reporting p50/p99 latency + rows/s per
+# concurrency x batch-size shape. Bank with
+# LGBM_TPU_SERVE_OUT=SERVE_r<N>.json.
+serve:
+	env LGBM_TPU_SERVE_ROWS=20000 python bench.py --serve
 
 # Perf regression gate (docs/TPU-Performance.md): assert the committed
 # PERF_LEDGER.json matches the checked-in BENCH_*/MULTICHIP_* history (no
@@ -109,4 +121,4 @@ trace:
 	@echo "trace: $$(ls -1t .telemetry/trace_*.json | head -1)"
 
 .PHONY: lint verify check-fast check capi bench-cpu chaos bench-chaos \
-        trace bench-diff ledger multichip stream
+        trace bench-diff ledger multichip stream serve

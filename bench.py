@@ -1555,6 +1555,146 @@ def run_stream(argv=None):
     return 0 if ok else 1
 
 
+# ------------------------------------------------------------- serve phase
+
+def run_serve(argv=None):
+    """`bench.py --serve`: the production-inference phase
+    (lightgbm_tpu/serving, docs/Serving.md). Hermetic CPU, like --smoke.
+    What it proves:
+
+    1. INTERCHANGE — the model travels train -> protobuf file ->
+       ServingEngine, and the served predictions are BIT-identical to the
+       training booster's in-memory predict() on the same rows (asserted;
+       the traversal is integer rank-exact on device and the leaf sum is
+       host f64 in tree order).
+    2. 0-RECOMPILE — after warmup() AOT-compiles the bucket ladder, closed
+       and open-loop load across every batch-size shape adds ZERO jit
+       cache misses (RecompileGuard over the engine's entrypoints; the
+       padding ladder is the whole point).
+    3. LATENCY/THROUGHPUT — closed-loop p50/p99 latency and rows/s at
+       several concurrency x batch-size shapes, plus an open-loop Poisson
+       arm through the MicroBatcher (queue delay included — the SLO view),
+       with batch fill fraction and queue peak from the metrics registry.
+
+    Prints ONE JSON line (bench schema + serve extras; the `serve` field
+    keys it into its own perf-ledger comparability class and `p99_ms`
+    joins the regression gate); exit 0 iff identity + guard assertions
+    hold. LGBM_TPU_SERVE_OUT banks the payload as SERVE_r<N>.json."""
+    from lightgbm_tpu.utils.hermetic import force_cpu_backend
+    force_cpu_backend()
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import observability as obs
+    from lightgbm_tpu.analysis.guards import GuardViolation, RecompileGuard
+    from lightgbm_tpu.serving import MicroBatcher, ServingEngine
+    from lightgbm_tpu.serving.loadgen import run_closed_loop, run_open_loop
+
+    n_rows = int(os.environ.get("LGBM_TPU_SERVE_ROWS", "20000"))
+    n_trees = int(os.environ.get("LGBM_TPU_SERVE_TREES", "30"))
+    X, y = _higgs_like(n_rows)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31, "max_bin": 63,
+                     "learning_rate": 0.1, "min_data_in_leaf": 20,
+                     "verbose": -1, "metric": "none", "seed": 7},
+                    lgb.Dataset(X, label=y), num_boost_round=n_trees)
+    probe = X[:2048]
+    p_train = bst.predict(probe)
+
+    buckets = os.environ.get("LGBM_TPU_SERVE_BUCKETS", "1,8,64,512")
+    out = {"metric": "serve_bench", "unit": "rows/s", "platform": "cpu",
+           "rows": n_rows, "kernel": "xla", "n_devices": 1,
+           "trees": n_trees, "buckets": [int(b) for b in buckets.split(",")]}
+    ok, err = True, []
+
+    with tempfile.TemporaryDirectory() as td:
+        proto_path = os.path.join(td, "model.proto")
+        bst.save_model(proto_path)
+        engine = ServingEngine(
+            proto_path, params={"serve_buckets": buckets,
+                                "serve_max_batch_rows": 512,
+                                "serve_max_wait_ms": 2.0, "verbose": -1})
+        out["warmup_compiles"] = int(
+            obs.get_registry().counter("serve.bucket_compiles").value)
+
+        # ---- interchange identity: proto -> engine === in-memory train ----
+        p_served = engine.predict(probe)
+        out["identical_to_train_predict"] = bool(
+            np.array_equal(p_train, p_served))
+        if not out["identical_to_train_predict"]:
+            ok = False
+            err.append("served predictions differ from the training "
+                       "booster's predict() (max abs diff %g)"
+                       % float(np.max(np.abs(p_train - p_served))))
+
+        # ---- load under the recompile pin --------------------------------
+        guard = RecompileGuard(label="serve")
+        for name, fn in engine.jit_entrypoints():
+            guard.register(fn, name)
+        closed, open_arm = {}, None
+        try:
+            with guard:
+                guard.mark_warm()
+                for batch, conc in ((1, 1), (8, 4), (64, 4), (512, 2)):
+                    r = run_closed_loop(
+                        engine.predict, X, batch, conc,
+                        requests_per_worker=max(240 // (conc * max(
+                            batch // 8, 1)), 10))
+                    closed[f"b{batch}xc{conc}"] = r
+                    if r["errors"]:
+                        ok = False
+                        err.append(f"closed-loop errors at b{batch}xc{conc}: "
+                                   f"{r['errors'][:2]}")
+                with MicroBatcher(engine) as mb:
+                    open_arm = run_open_loop(
+                        mb.predict, X, batch_rows=4, rate_rps=200.0,
+                        duration_s=2.0, seed=11)
+                    if open_arm["errors"]:
+                        ok = False
+                        err.append(f"open-loop errors: "
+                                   f"{open_arm['errors'][:2]}")
+        except GuardViolation as e:
+            ok = False
+            err.append(str(e)[:300])
+        rep = guard.report()
+        out["recompiles_post_warmup"] = rep["post_warmup_cache_misses"]
+        if rep["post_warmup_cache_misses"]:
+            ok = False
+            err.append(f"serving recompiled after warmup: "
+                       f"{rep['misses_by_entrypoint']}")
+
+        snap = obs.snapshot()
+        fill = (snap.get("histograms") or {}).get("serve.batch_fill_frac")
+        lat = (snap.get("summaries") or {}).get("serve.latency_ms")
+        out["closed"] = closed
+        out["open"] = open_arm
+        out["batch_fill_frac_mean"] = fill.get("mean") if fill else None
+        out["queue_peak"] = (snap.get("gauges") or {}).get("serve.queue_peak")
+        out["snapshot_latency"] = {k: lat.get(k) for k in
+                                   ("p50", "p99", "count")} if lat else None
+
+    # headline: the biggest closed-loop shape's throughput + its p99 —
+    # `serve` names the shape so the ledger only compares like with like
+    head_key = "b512xc2"
+    head = closed.get(head_key) or {}
+    out["serve"] = f"closed|{head_key}"
+    out["value"] = head.get("rows_per_s")
+    out["p99_ms"] = head.get("p99_ms")
+    out["p50_ms"] = head.get("p50_ms")
+    if not isinstance(out["value"], (int, float)) or not out["value"]:
+        ok = False
+        err.append(f"no headline throughput measured for {head_key}")
+
+    out["ok"] = ok
+    if err:
+        out["error"] = "; ".join(err)[:500]
+    print(json.dumps(out))
+    out_path = os.environ.get("LGBM_TPU_SERVE_OUT", "")
+    if out_path:
+        from lightgbm_tpu.observability.export import atomic_write_json
+        atomic_write_json(out_path, out)
+    return 0 if ok else 1
+
+
 # ------------------------------------------------------------- chaos phase
 
 def run_chaos(argv=None):
@@ -2133,6 +2273,25 @@ def run_compare(argv):
                              "problems": sp, "notes": sn, "ok": not sp}
             problems = problems + sp
             break
+        # ... and the newest banked SERVE result (bench.py --serve): the
+        # |serve= comparability key plus the p99 floor means a serving
+        # rows/s OR tail-latency regression fails here without ever being
+        # judged against a training-throughput number
+        for p in reversed(sorted(
+                _glob.glob(os.path.join(repo, "SERVE_r*.json")))):
+            pl = perf_ledger.payload_of(p)
+            if not pl or pl.get("metric") != "serve_bench":
+                continue
+            vp, vn = perf_ledger.compare(
+                pl, entries, exclude_source=os.path.basename(p))
+            out["serve"] = {"candidate": os.path.basename(p),
+                            "value": pl.get("value"),
+                            "p99_ms": pl.get("p99_ms"),
+                            "identical_to_train_predict":
+                                pl.get("identical_to_train_predict"),
+                            "problems": vp, "notes": vn, "ok": not vp}
+            problems = problems + vp
+            break
     out["problems"] = problems
     out["ok"] = not problems
     print(json.dumps(out))
@@ -2146,6 +2305,8 @@ if __name__ == "__main__":
         sys.exit(run_smoke())
     elif "--stream" in sys.argv:
         sys.exit(run_stream(sys.argv))
+    elif "--serve" in sys.argv:
+        sys.exit(run_serve(sys.argv))
     elif "--chaos" in sys.argv:
         sys.exit(run_chaos(sys.argv))
     elif "--compare" in sys.argv:

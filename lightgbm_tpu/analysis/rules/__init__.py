@@ -10,6 +10,7 @@ from .sort_in_loop import SortInLoopRule           # R007
 from .ad_hoc_timing import AdHocTimingRule         # R008
 from .device_transfer import DeviceTransferRule    # R009
 from .swallowed_exceptions import SwallowedExceptionRule  # R010
+from .serving_sync import ServingSyncRule          # R011
 
 _RULES = None
 
@@ -20,5 +21,5 @@ def active_rules():
         _RULES = [ControlFlowRule(), HostSyncRule(), DtypePromotionRule(),
                   PallasShapeRule(), StaticArgsRule(), ImportExecRule(),
                   SortInLoopRule(), AdHocTimingRule(), DeviceTransferRule(),
-                  SwallowedExceptionRule()]
+                  SwallowedExceptionRule(), ServingSyncRule()]
     return _RULES

@@ -1,0 +1,105 @@
+"""R011: un-sanctioned host syncs in the serving dispatch path.
+
+The serving engine's latency contract is ONE device->host sync per
+dispatch: the result fetch. Any other materialization in
+``lightgbm_tpu/serving/`` — a ``.block_until_ready()`` "to be safe", an
+``np.asarray`` on an intermediate device value, a stray ``.item()`` in
+the batcher loop — serializes the pipeline once per request and is
+exactly the class of silent p99 regression the micro-batcher exists to
+avoid. The one contractual sync (``ServingEngine._dispatch``'s result
+fetch) is baseline-exempt (``tpu_lint_baseline.json``); anything new
+fails the lint.
+
+What fires, inside ``lightgbm_tpu/serving/`` only:
+
+- ``.block_until_ready()`` / ``.item()`` / ``.tolist()`` method calls and
+  ``jax.device_get(...)`` — always (these exist only to sync);
+- ``np.asarray(...)`` / ``np.array(...)`` when the argument is a CALL
+  result or a name assigned from a non-numpy call in the same function —
+  i.e. materializing something just computed (plausibly a device value).
+  Plain input normalization (``np.asarray(X)`` on a function parameter)
+  stays legal: converting caller data is host work, not a sync.
+
+The runtime twin is the RecompileGuard's transfer counter, which
+``bench.py --serve`` runs over the whole load phase.
+"""
+from __future__ import annotations
+
+import ast
+
+from .common import dotted_name, iter_functions
+
+RULE_ID = "R011"
+
+_NP_MATERIALIZE = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_ALWAYS_CALLS = {"jax.device_get"}
+_ALWAYS_METHODS = {"block_until_ready", "item", "tolist"}
+
+_SCOPE_MARKER = "lightgbm_tpu/serving/"
+
+
+def _device_ish_names(fn) -> set:
+    """Names assigned (in ``fn``) from a call whose root is NOT numpy —
+    conservatively 'possibly a device value'."""
+    out = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        callee = dotted_name(node.value.func) or ""
+        if callee.startswith(("np.", "numpy.")):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+    return out
+
+
+class ServingSyncRule:
+    rule_id = RULE_ID
+    summary = ("un-sanctioned host sync (np.asarray on a computed value / "
+               ".block_until_ready / .item / jax.device_get) inside "
+               "lightgbm_tpu/serving/ — the dispatch path syncs exactly "
+               "once, at the contractual result fetch")
+
+    def check(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        if _SCOPE_MARKER not in rel:
+            return
+        for fn in iter_functions(ctx.tree):
+            device_ish = _device_ish_names(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                if name in _ALWAYS_CALLS:
+                    yield ctx.finding(
+                        self.rule_id, node,
+                        f"`{name}()` in serving code — an explicit "
+                        f"device->host sync outside the contractual result "
+                        f"fetch; serving dispatch must stay async "
+                        f"(baseline an audited site, never add one "
+                        f"casually)")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _ALWAYS_METHODS
+                      and not (isinstance(node.func.value, ast.Name)
+                               and node.func.value.id in ("self",))):
+                    yield ctx.finding(
+                        self.rule_id, node,
+                        f"`.{node.func.attr}()` in serving code — blocks "
+                        f"on the device (or materializes a device value) "
+                        f"per call; the serving path's one sanctioned sync "
+                        f"is the dispatch result fetch")
+                elif name in _NP_MATERIALIZE and node.args:
+                    arg = node.args[0]
+                    is_computed = isinstance(arg, ast.Call) or (
+                        isinstance(arg, ast.Name) and arg.id in device_ish)
+                    if is_computed:
+                        yield ctx.finding(
+                            self.rule_id, node,
+                            f"`{name}()` on a just-computed value in "
+                            f"serving code — if that value lives on "
+                            f"device this is a hidden per-request sync; "
+                            f"the one contractual result fetch is "
+                            f"baseline-exempt, everything else stays "
+                            f"device-side or pre-materialized")

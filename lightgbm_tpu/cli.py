@@ -25,7 +25,7 @@ from .utils.log import Log
 # every task value main() dispatches on (bare-subcommand whitelist derives
 # from this so the two can't drift)
 TASK_TOKENS = ("train", "predict", "prediction", "test",
-               "convert_model", "convert")
+               "convert_model", "convert", "serve_bench")
 
 
 def parse_args(argv: List[str]) -> Dict[str, str]:
@@ -220,6 +220,42 @@ def run_predict(params: Dict) -> None:
     Log.info("Finished prediction, results saved to %s", config.output_result)
 
 
+def run_serve_bench(params: Dict) -> None:
+    """task=serve_bench: load a model (text/proto/JSON) into the serving
+    engine, replay closed-loop load from `data=` at a few concurrency x
+    batch-size shapes, and print one JSON report with p50/p99 latency and
+    rows/s per shape (docs/Serving.md). The hermetic full-harness version
+    — Poisson open loop, recompile pinning, ledger banking — is
+    ``python bench.py --serve``; this task is the operator's quick probe
+    against a real model artifact."""
+    import json
+
+    config = Config.from_params(params)
+    Log.set_level(config.verbose)
+    if not config.input_model:
+        Log.fatal("No input model specified for serve_bench (input_model=...)")
+    if not config.data:
+        Log.fatal("No request data specified for serve_bench (data=...)")
+    from .serving import ServingEngine
+    from .serving.loadgen import run_closed_loop
+    engine = ServingEngine(config.input_model, params=params)
+    X, _, _ = load_data_file(config.data, params)
+    X = np.asarray(X, np.float64)
+    shapes = [(1, 1), (8, 4), (64, 4)]
+    shapes = [(b, c) for b, c in shapes if b <= X.shape[0]] or [(X.shape[0], 1)]
+    report = {"task": "serve_bench", "model": config.input_model,
+              "engine": engine.describe(), "shapes": {}}
+    for batch, conc in shapes:
+        r = run_closed_loop(engine.predict, X, batch, conc,
+                            requests_per_worker=max(200 // conc, 20))
+        report["shapes"][f"b{batch}xc{conc}"] = r
+    print(json.dumps(report))
+    if config.dump_snapshot:
+        from . import observability as obs
+        obs.write_snapshot(config.dump_snapshot)
+        Log.info("serving snapshot written to %s", config.dump_snapshot)
+
+
 def run_convert_model(params: Dict) -> None:
     config = Config.from_params(params)
     Log.set_level(config.verbose)
@@ -242,6 +278,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_predict(params)
     elif task in ("convert_model", "convert"):
         run_convert_model(params)
+    elif task == "serve_bench":
+        run_serve_bench(params)
     else:
         Log.fatal("Unknown task %s", task)
     return 0

@@ -392,6 +392,19 @@ class Config:
     # fall back to 1 (loudly). See docs/TPU-Performance.md.
     tree_batch: int = 1
 
+    # --- serving (lightgbm_tpu/serving, docs/Serving.md) --------------------
+    # largest rows-per-dispatch the serving engine compiles for; also the
+    # micro-batcher's coalescing budget and the top of the auto bucket
+    # ladder. Requests beyond it are chunked.
+    serve_max_batch_rows: int = 4096
+    # micro-batcher coalescing window: a queued request waits at most this
+    # long past its arrival for companions before dispatching
+    serve_max_wait_ms: float = 2.0
+    # batch-size bucket ladder (comma list, strictly ascending) the engine
+    # AOT-compiles and pads requests into; "" = powers of two
+    # 1,2,4,...,serve_max_batch_rows (padding never exceeds 2x)
+    serve_buckets: str = ""
+
     # --- fault tolerance (robustness/, docs/Fault-Tolerance.md) -------------
     # directory of atomic booster snapshots (ckpt_<id>.pkl); empty = off
     checkpoint_dir: str = ""
@@ -507,6 +520,28 @@ class Config:
             Log.fatal("Number of classes should be > 1 for multiclass training")
         if self.top_rate + self.other_rate > 1.0:
             Log.fatal("top_rate + other_rate cannot be larger than 1.0 for GOSS")
+        if self.serve_max_batch_rows < 1:
+            Log.fatal("serve_max_batch_rows must be >= 1, got %d",
+                      self.serve_max_batch_rows)
+        if self.serve_max_wait_ms < 0:
+            Log.fatal("serve_max_wait_ms must be >= 0, got %g",
+                      self.serve_max_wait_ms)
+        if self.serve_buckets:
+            try:
+                ladder = [int(v) for v in
+                          str(self.serve_buckets).split(",") if v]
+            except ValueError:
+                ladder = []
+            if not ladder or any(b < 1 for b in ladder) or \
+                    any(b >= c for b, c in zip(ladder, ladder[1:])):
+                Log.fatal("serve_buckets must be a comma list of strictly "
+                          "ascending positive ints, got %r",
+                          self.serve_buckets)
+            elif ladder[-1] > self.serve_max_batch_rows:
+                Log.fatal("serve_buckets top entry %d exceeds "
+                          "serve_max_batch_rows=%d (the largest "
+                          "rows-per-dispatch the engine compiles for)",
+                          ladder[-1], self.serve_max_batch_rows)
         if self.nan_policy not in ("none", "raise", "skip_iter", "clip"):
             Log.fatal("Unknown nan_policy %s (none|raise|skip_iter|clip)",
                       self.nan_policy)

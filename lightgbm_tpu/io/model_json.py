@@ -1,14 +1,24 @@
-"""JSON model dump (reference: GBDT::DumpModel gbdt_model_text.cpp:13-48,
-Tree::ToJSON / NodeToJSON src/io/tree.cpp)."""
+"""JSON model dump + load (reference: GBDT::DumpModel
+gbdt_model_text.cpp:13-48, Tree::ToJSON / NodeToJSON src/io/tree.cpp).
+
+The loader re-hydrates the dump into model-space ``Tree`` objects so the
+serving engine can ingest JSON artifacts next to text/proto. The
+objective serializes as the full parameterized string
+(``binary sigmoid:2.5``) exactly like the text/proto writers, so
+prediction transforms survive the round trip; the one lossy corner (the
+reference's own convention) is infinite thresholds clamping to 1e308 —
+prefer protobuf for production round trips."""
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..tree import K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK, Tree
+from .model_text import _objective_string
 
 _MISSING_NAMES = {0: "None", 1: "Zero", 2: "NaN"}
+_MISSING_CODES = {v: k for k, v in _MISSING_NAMES.items()}
 
 
 def _node_to_dict(tree: Tree, index: int) -> Dict:
@@ -71,9 +81,124 @@ def dump_model_dict(booster, num_iteration: Optional[int] = None) -> Dict:
         "num_tree_per_iteration": K,
         "label_index": 0,
         "max_feature_idx": booster.num_total_features - 1,
-        "objective": booster.config.objective,
+        # full objective string WITH params (``binary sigmoid:2.5``), like
+        # the text/proto writers — the bare name loses sigmoid/num_class
+        # and a reloaded model would transform predictions differently
+        "objective": _objective_string(booster),
         "average_output": booster.config.boosting_normalized == "rf",
         "feature_names": names,
         "tree_info": [dict(tree_index=i, **_tree_to_dict(t))
                       for i, t in enumerate(trees)],
     }
+
+
+# ------------------------------------------------------------------ loading
+
+def _tree_from_dict(d: Dict) -> Tree:
+    """Inverse of ``_tree_to_dict``: flatten the nested node dict back into
+    model-space arrays (pre-order over split_index/leaf_index)."""
+    num_leaves = int(d["num_leaves"])
+    M = max(num_leaves - 1, 0)
+    split_feature = np.zeros(M, np.int32)
+    threshold_bin = np.zeros(M, np.int32)
+    threshold = np.zeros(M, np.float64)
+    decision_type = np.zeros(M, np.uint8)
+    left_child = np.zeros(M, np.int32)
+    right_child = np.zeros(M, np.int32)
+    split_gain = np.zeros(M, np.float64)
+    internal_value = np.zeros(M, np.float64)
+    internal_count = np.zeros(M, np.int64)
+    leaf_value = np.zeros(max(num_leaves, 1), np.float64)
+    leaf_count = np.zeros(max(num_leaves, 1), np.int64)
+    cat_boundaries: List[int] = [0]
+    cat_words: List[np.ndarray] = []
+
+    def child_index(node: Dict) -> int:
+        return int(node["split_index"]) if "split_index" in node \
+            else ~int(node.get("leaf_index", 0))
+
+    def walk(node: Dict) -> None:
+        if "split_index" not in node:
+            leaf = int(node.get("leaf_index", 0))
+            leaf_value[leaf] = float(node["leaf_value"])
+            leaf_count[leaf] = int(node.get("leaf_count", 0))
+            return
+        i = int(node["split_index"])
+        split_feature[i] = int(node["split_feature"])
+        split_gain[i] = float(node.get("split_gain", 0.0))
+        internal_value[i] = float(node.get("internal_value", 0.0))
+        internal_count[i] = int(node.get("internal_count", 0))
+        dt = 0
+        if node.get("decision_type") == "==":
+            dt |= K_CATEGORICAL_MASK
+            cats = [int(c) for c in str(node["threshold"]).split("||") if c]
+            n_words = (max(cats) // 32 + 1) if cats else 1
+            words = np.zeros(n_words, np.uint32)
+            for c in cats:
+                words[c // 32] |= np.uint32(1) << np.uint32(c % 32)
+            cat_idx = len(cat_boundaries) - 1
+            threshold_bin[i] = cat_idx
+            threshold[i] = float(cat_idx)
+            cat_boundaries.append(cat_boundaries[-1] + n_words)
+            cat_words.append(words)
+        else:
+            threshold[i] = float(node["threshold"])
+        if node.get("default_left"):
+            dt |= K_DEFAULT_LEFT_MASK
+        dt |= _MISSING_CODES.get(node.get("missing_type", "None"), 0) << 2
+        decision_type[i] = dt
+        left_child[i] = child_index(node["left_child"])
+        right_child[i] = child_index(node["right_child"])
+        walk(node["left_child"])
+        walk(node["right_child"])
+
+    root = d.get("tree_structure") or {}
+    if num_leaves <= 1:
+        leaf_value[0] = float(root.get("leaf_value", 0.0))
+    else:
+        walk(root)
+    has_cat = len(cat_words) > 0
+    return Tree(
+        num_leaves=num_leaves,
+        split_feature=split_feature, threshold_bin=threshold_bin,
+        threshold=threshold, decision_type=decision_type,
+        left_child=left_child, right_child=right_child,
+        split_gain=split_gain, internal_value=internal_value,
+        internal_count=internal_count, leaf_value=leaf_value,
+        leaf_count=leaf_count,
+        leaf_parent=np.full(max(num_leaves, 1), -1, np.int32),
+        shrinkage=float(d.get("shrinkage", 1.0)),
+        cat_boundaries=np.asarray(cat_boundaries, np.int32)
+        if has_cat else None,
+        cat_threshold=np.concatenate(cat_words).astype(np.uint32)
+        if has_cat else None,
+    )
+
+
+def load_model_dict(booster, doc: Dict) -> None:
+    """Re-hydrate a ``dump_model``-shaped dict into ``booster``."""
+    from .model_text import apply_model_header
+    booster.trees = [_tree_from_dict(t) for t in doc.get("tree_info", [])]
+    booster._forest_rev = getattr(booster, "_forest_rev", 0) + 1
+    booster.num_model_per_iteration = int(
+        doc.get("num_tree_per_iteration", 1)) or 1
+    booster.num_total_features = int(doc.get("max_feature_idx", -1)) + 1
+    booster.feature_names = list(doc.get("feature_names", []))
+    apply_model_header(booster, doc.get("objective"),
+                       int(doc.get("num_class", 1)) or 1,
+                       doc.get("average_output"))
+
+
+def save_model_json(booster, filename: str,
+                    num_iteration: Optional[int] = None) -> None:
+    """Write the ``dump_model`` dict as a .json artifact (atomic, like the
+    text/proto writers) — the symmetric half of ``load_model_json`` so
+    ``save_model("m.json")`` round-trips through its own loader."""
+    from ..observability.export import atomic_write_json
+    atomic_write_json(filename, dump_model_dict(booster, num_iteration))
+
+
+def load_model_json(booster, filename: str) -> None:
+    import json
+    with open(filename, "r") as fh:
+        load_model_dict(booster, json.load(fh))

@@ -41,12 +41,21 @@ def _tree_to_proto(t: Tree, msg) -> None:
 def _tree_from_proto(msg) -> Tree:
     num_leaves = msg.num_leaves
     M = num_leaves - 1
+    thresholds = np.array(msg.threshold[:M], dtype=np.float64)
+    decision_types = np.array(msg.decision_type[:M], dtype=np.uint8)
+    # categorical nodes store their cat_boundaries index in `threshold`
+    # (same convention as the text format, tree.cpp ToString) — it must
+    # come back as threshold_bin or every categorical split dereferences
+    # bitset 0 after a proto round trip
+    is_cat_node = (decision_types & 1).astype(bool)
+    threshold_bin = np.zeros(M, dtype=np.int32)
+    threshold_bin[is_cat_node] = thresholds[is_cat_node].astype(np.int32)
     tree = Tree(
         num_leaves=num_leaves,
         split_feature=np.array(msg.split_feature[:M], dtype=np.int32),
-        threshold_bin=np.zeros(M, dtype=np.int32),
-        threshold=np.array(msg.threshold[:M], dtype=np.float64),
-        decision_type=np.array(msg.decision_type[:M], dtype=np.uint8),
+        threshold_bin=threshold_bin,
+        threshold=thresholds,
+        decision_type=decision_types,
         left_child=np.array(msg.left_child[:M], dtype=np.int32),
         right_child=np.array(msg.right_child[:M], dtype=np.int32),
         split_gain=np.array(msg.split_gain[:M], dtype=np.float64),
@@ -97,18 +106,5 @@ def load_model_proto(booster, filename: str) -> None:
     booster.num_model_per_iteration = m.num_tree_per_iteration or 1
     booster.num_total_features = m.max_feature_idx + 1
     booster.feature_names = list(m.feature_names)
-    params = dict(booster.params)
-    toks = (m.objective or "regression").split()
-    params["objective"] = toks[0]
-    for tok in toks[1:]:
-        if ":" in tok:
-            k, v = tok.split(":", 1)
-            params[k] = v
-    params["num_class"] = m.num_class or 1
-    if m.average_output:
-        params["boosting_type"] = "rf"
-        params.setdefault("bagging_freq", 1)
-        params.setdefault("bagging_fraction", 0.5)
-    from ..config import Config
-    booster.config = Config.from_params(params)
-    booster.params = params
+    from .model_text import apply_model_header
+    apply_model_header(booster, m.objective, m.num_class, m.average_output)

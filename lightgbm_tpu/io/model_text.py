@@ -141,6 +141,13 @@ def save_model_file(booster, filename: str, num_iteration: Optional[int] = None)
         from .model_proto import save_model_proto
         save_model_proto(booster, filename, num_iteration)
         return
+    if str(filename).endswith(".json"):
+        # mirror of the loader's .json dispatch: a model SAVED under a
+        # .json name must be the dump_model artifact the loader parses —
+        # writing text here would break its own round trip
+        from .model_json import save_model_json
+        save_model_json(booster, filename, num_iteration)
+        return
     # atomic write: every rank of a distributed run saves (the reference's
     # behavior — each machine keeps a local copy), and same-host ranks must
     # not interleave into a truncated file; tmp-per-pid + rename means the
@@ -198,6 +205,32 @@ def _parse_tree_block(lines: Dict[str, str]) -> Tree:
     return tree
 
 
+def apply_model_header(booster, objective_str, num_class, average_output
+                       ) -> None:
+    """Shared booster-metadata rehydration tail of every model loader
+    (text/proto/JSON): split the objective string into its name and
+    ``key:value`` params (``binary sigmoid:2.5``), restore num_class, and
+    apply the rf/average_output bagging defaults — then rebuild the
+    Config so prediction transforms (sigmoid, softmax, rf averaging) match
+    the model that was saved. One implementation: the three formats cannot
+    drift on what a loaded model's objective means."""
+    params = dict(booster.params)
+    toks = (objective_str or "regression").split()
+    params["objective"] = toks[0]
+    for tok in toks[1:]:
+        if ":" in tok:
+            k, v = tok.split(":", 1)
+            params[k] = v
+    params["num_class"] = int(num_class or 1)
+    if average_output:
+        params["boosting_type"] = "rf"
+        params.setdefault("bagging_freq", 1)
+        params.setdefault("bagging_fraction", 0.5)
+    from ..config import Config
+    booster.config = Config.from_params(params)
+    booster.params = params
+
+
 def load_model_string(booster, model_str: str) -> None:
     lines = model_str.splitlines()
     header: Dict[str, str] = {}
@@ -229,21 +262,8 @@ def load_model_string(booster, model_str: str) -> None:
     booster.num_model_per_iteration = int(header.get("num_tree_per_iteration", "1"))
     booster.num_total_features = int(header.get("max_feature_idx", "-1")) + 1
     booster.feature_names = header.get("feature_names", "").split()
-    obj_str = header.get("objective", "regression").split()
-    params = dict(booster.params)
-    params["objective"] = obj_str[0]
-    for tok in obj_str[1:]:
-        if ":" in tok:
-            k, v = tok.split(":", 1)
-            params[k] = v
-    params["num_class"] = int(header.get("num_class", "1"))
-    if average_output:
-        params["boosting_type"] = "rf"
-        params.setdefault("bagging_freq", 1)
-        params.setdefault("bagging_fraction", 0.5)
-    from ..config import Config
-    booster.config = Config.from_params(params)
-    booster.params = params
+    apply_model_header(booster, header.get("objective", "regression"),
+                       int(header.get("num_class", "1")), average_output)
     for line in reversed(lines[-5:]):        # trailing JSON convention
         if line.startswith("pandas_categorical:"):
             import json
@@ -259,6 +279,12 @@ def load_model_file(booster, filename: str) -> None:
     if str(filename).endswith(".proto") or booster.params.get("model_format") == "proto":
         from .model_proto import load_model_proto
         load_model_proto(booster, filename)
+        return
+    if str(filename).endswith(".json"):
+        # dump_model() artifact — re-hydrated so the serving engine (and
+        # Booster(model_file=...)) ingest JSON next to text/proto
+        from .model_json import load_model_json
+        load_model_json(booster, filename)
         return
     with open(filename, "r") as fh:
         load_model_string(booster, fh.read())

@@ -7,9 +7,11 @@ One subsystem for every runtime signal the boosting stack produces:
   recorded at dispatch boundaries only so the fused step and the
   recompile-free steady state are preserved.
 - ``MetricsRegistry`` (metrics.py) — process-wide counters/gauges/
-  histograms absorbing ``RecompileGuard.report()``, ``PhaseBreakdown``,
-  comm retries/timeouts, ``nan_policy`` events, checkpoint writes,
-  per-booster kernel choice, waves per tree, rows routed.
+  histograms/quantile summaries absorbing ``RecompileGuard.report()``,
+  ``PhaseBreakdown``, comm retries/timeouts, ``nan_policy`` events,
+  checkpoint writes, per-booster kernel choice, waves per tree, rows
+  routed, and the serving subsystem's per-request latency p50/p99
+  (``serve.*``, docs/Serving.md).
 - exporters (export.py)           — JSONL event stream + Chrome trace-event
   JSON (Perfetto-loadable) under ``LGBM_TPU_TELEMETRY_DIR`` / config
   ``telemetry_dir``; ``snapshot()`` is the point-in-time serving API.

@@ -14,10 +14,12 @@ banked?*
   when it has).
 - ``compare(candidate, entries)`` — flag regressions of a fresh bench
   payload against best-known values: throughput (per platform/rows/kernel
-  comparability key), post-warm-up recompiles, headline host syncs, peak
-  HBM, and compiled cost-model drift (FLOPs / bytes accessed, when both
-  sides carry cost reports). ``bench.py --compare`` wraps this and exits
-  nonzero on any flag; ``make bench-diff`` wires it into ``make verify``.
+  comparability key; serving entries additionally key on the ``|serve=``
+  load shape), post-warm-up recompiles, headline host syncs, peak HBM,
+  serving p99 latency, and compiled cost-model drift (FLOPs / bytes
+  accessed, when both sides carry cost reports). ``bench.py --compare``
+  wraps this and exits nonzero on any flag; ``make bench-diff`` wires it
+  into ``make verify``.
 
 Deliberately dependency-free (stdlib + the jax-free sibling
 ``costs.drift`` for the one shared band check) and deterministic (no
@@ -43,6 +45,11 @@ DEFAULT_TOLERANCES = {
     "throughput": 0.15,       # value may sit up to 15% below best-known
     "hbm": 0.15,              # peak HBM may grow up to 15%
     "cost": 0.35,             # flops/bytes drift band vs recorded reports
+    # serving p99 latency may sit up to this far ABOVE the best-known
+    # floor: tail latency on a shared CI box is far noisier than
+    # throughput, so the band is wide — a real regression (an extra
+    # dispatch, a recompile in the loop) moves p99 by integer factors
+    "p99": 0.75,
 }
 
 
@@ -76,7 +83,7 @@ def normalize_bench(payload: Optional[Dict], source: str,
                "value": None, "unit": None, "vs_baseline": None,
                "platform": None, "rows": None, "kernel": None,
                "n_devices": None, "residency": None, "tree_batch": None,
-               "auc": None,
+               "auc": None, "serve": None, "p99_ms": None,
                "recompiles_post_warmup": None, "host_syncs": None,
                "steady_s_per_iter": None, "hbm_peak_gb": None,
                "cost": None, "error": None}
@@ -84,8 +91,8 @@ def normalize_bench(payload: Optional[Dict], source: str,
         e["error"] = "unparseable history file"
         return e
     for k in ("value", "unit", "vs_baseline", "platform", "rows", "kernel",
-              "n_devices", "residency", "tree_batch", "auc",
-              "recompiles_post_warmup", "hbm_peak_gb", "error"):
+              "n_devices", "residency", "tree_batch", "auc", "serve",
+              "p99_ms", "recompiles_post_warmup", "hbm_peak_gb", "error"):
         if payload.get(k) is not None:
             e[k] = payload[k]
     head = (payload.get("phase_timings") or {}).get("headline") or {}
@@ -137,10 +144,12 @@ def load_history(root: str) -> List[Dict]:
     """Normalized entries from every checked-in BENCH/MULTICHIP file,
     round order."""
     entries: List[Dict] = []
-    # STREAM_r*.json (bench.py --stream) shares the bench schema; its
-    # residency=stream field keys it into its own comparability class
+    # STREAM_r*.json (bench.py --stream) and SERVE_r*.json (bench.py
+    # --serve) share the bench schema; the residency=stream / serve=shape
+    # fields key each into its own comparability class
     for pat, norm in (("BENCH_r*.json", normalize_bench),
                       ("STREAM_r*.json", normalize_bench),
+                      ("SERVE_r*.json", normalize_bench),
                       ("MULTICHIP_r*.json", normalize_multichip)):
         for path in sorted(glob.glob(os.path.join(root, pat))):
             entries.append(norm(payload_of(path), os.path.basename(path),
@@ -165,11 +174,15 @@ def comparability_key(e: Dict) -> str:
     different kernel's best, a single-chip headline against an 8-chip
     mesh run, or a host-streamed out-of-core run
     (``tpu_residency=stream``, which pays H2D per wave by design) against
-    a fully device-resident one. Fields absent on older history are None
-    — those entries keep comparing among themselves."""
+    a fully device-resident one. Serving results (``bench.py --serve``)
+    additionally key on the load shape (``serve="closed|b512xc2"``) — a
+    1-row-latency arm must never be judged against a 512-row-throughput
+    arm, and training benches (serve=None) never mix with serving ones.
+    Fields absent on older history are None — those entries keep comparing
+    among themselves."""
     return (f"platform={e.get('platform')}|rows={e.get('rows')}"
             f"|kernel={e.get('kernel')}|n_devices={e.get('n_devices')}"
-            f"|residency={e.get('residency')}")
+            f"|residency={e.get('residency')}|serve={e.get('serve')}")
 
 
 def multichip_key(e: Dict) -> str:
@@ -219,7 +232,8 @@ def best_known(entries: List[Dict],
         group = [e for e in entries if _clean(e)
                  and e.get("source") != exclude_source
                  and comparability_key(e) == key]
-        for field in ("recompiles_post_warmup", "host_syncs", "hbm_peak_gb"):
+        for field in ("recompiles_post_warmup", "host_syncs", "hbm_peak_gb",
+                      "p99_ms"):
             vals = [e[field] for e in group if e.get(field) is not None]
             slot[f"min_{field}"] = min(vals) if vals else None
     return best
@@ -234,7 +248,8 @@ def build_ledger(root: str) -> Dict:
                 "min_recompiles_post_warmup":
                     v.get("min_recompiles_post_warmup"),
                 "min_host_syncs": v.get("min_host_syncs"),
-                "min_hbm_peak_gb": v.get("min_hbm_peak_gb")}
+                "min_hbm_peak_gb": v.get("min_hbm_peak_gb"),
+                "min_p99_ms": v.get("min_p99_ms")}
             for k, v in sorted(best_known(entries).items())}
     best_mc = {k: {"source": v["source"], "round": v["round"],
                    "value": v["value"],
@@ -328,6 +343,12 @@ def compare(candidate: Dict, entries: List[Dict],
             problems.append(
                 f"peak-HBM regression: {c['hbm_peak_gb']} GB vs best-known "
                 f"{min_hbm} GB (+{tol['hbm']:.0%} band)")
+        min_p99 = slot.get("min_p99_ms")
+        if (min_p99 is not None and c.get("p99_ms") is not None
+                and c["p99_ms"] > min_p99 * (1.0 + tol["p99"])):
+            problems.append(
+                f"p99 latency regression: {c['p99_ms']} ms vs best-known "
+                f"{min_p99} ms (+{tol['p99']:.0%} band)")
         problems.extend(_cost_drift(c, b, tol["cost"]))
     return problems, notes
 

@@ -1,4 +1,4 @@
-"""Process-wide metrics registry: counters, gauges, histograms.
+"""Process-wide metrics registry: counters, gauges, histograms, summaries.
 
 One registry per process (``observability.get_registry()``) absorbs every
 runtime signal the training stack used to scatter across ad-hoc consumers:
@@ -6,7 +6,9 @@ runtime signal the training stack used to scatter across ad-hoc consumers:
 publishes them on guard exit), comm retry/timeout events
 (robustness/retry.py, parallel/comm.py), ``nan_policy`` events
 (boosting/gbdt.py), checkpoint writes (robustness/checkpoint.py), per-booster
-kernel choice, waves per tree, and rows routed. ``bench.py`` reads the same
+kernel choice, waves per tree, rows routed, and the serving subsystem's
+per-request traffic (``serve.*`` counters plus the quantile-capable
+``Summary`` latency metrics — docs/Serving.md). ``bench.py`` reads the same
 registry for its ``telemetry`` summary block instead of keeping parallel
 bookkeeping.
 
@@ -19,6 +21,7 @@ hot path.
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Dict, Optional
@@ -75,6 +78,61 @@ class Histogram:
             self.max = v if self.max is None else max(self.max, v)
 
 
+class Summary:
+    """Windowed quantile summary: lifetime count/sum/min/max plus a bounded
+    ring of the most recent ``window`` observations from which ``snapshot``
+    computes p50/p90/p99 (nearest-rank over the window). The serving
+    subsystem's per-request latency metrics (``serve.latency_ms``,
+    ``serve.dispatch_ms``) are the consumers — a plain Histogram's
+    count/sum/min/max cannot answer the p99 question a latency SLO asks.
+    The window bounds memory (one float per slot) and biases the quantiles
+    toward RECENT traffic, which is what a live probe wants."""
+    __slots__ = ("name", "_lock", "count", "sum", "min", "max",
+                 "window", "_ring", "_next")
+
+    def __init__(self, name: str, lock: threading.Lock, window: int = 8192):
+        self.name = name
+        self._lock = lock
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.window = int(window)
+        self._ring: list = []
+        self._next = 0
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if len(self._ring) < self.window:
+                self._ring.append(v)
+            else:
+                self._ring[self._next] = v
+            self._next = (self._next + 1) % self.window
+
+    @staticmethod
+    def _quantiles_of(data: list, qs=(0.5, 0.9, 0.99)
+                      ) -> Dict[str, Optional[float]]:
+        """Nearest-rank quantiles of an already-sorted sample (caller holds
+        whatever lock protects the sample)."""
+        out: Dict[str, Optional[float]] = {}
+        n = len(data)
+        for q in qs:
+            key = f"p{int(q * 100)}"
+            out[key] = None if n == 0 else \
+                data[min(n - 1, max(0, math.ceil(q * n) - 1))]
+        return out
+
+    def quantiles(self, qs=(0.5, 0.9, 0.99)) -> Dict[str, Optional[float]]:
+        with self._lock:
+            data = sorted(self._ring)
+        return self._quantiles_of(data, qs)
+
+
 class MetricsRegistry:
     """Named metric store; metrics are created on first use so producers
     never need registration order coordination."""
@@ -84,6 +142,7 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._summaries: Dict[str, Summary] = {}
 
     # ------------------------------------------------------------- accessors
 
@@ -109,6 +168,14 @@ class MetricsRegistry:
                                                 Histogram(name, self._lock))
         return h
 
+    def summary(self, name: str, window: int = 8192) -> Summary:
+        s = self._summaries.get(name)
+        if s is None:
+            with self._lock:
+                s = self._summaries.setdefault(
+                    name, Summary(name, self._lock, window=window))
+        return s
+
     def inc(self, name: str, n: int = 1) -> None:
         """Convenience: ``registry.inc("comm.retries")``."""
         self.counter(name).inc(n)
@@ -128,8 +195,22 @@ class MetricsRegistry:
                     "min": h.min, "max": h.max,
                     "mean": round(h.sum / h.count, 6) if h.count else None,
                 }
-        return {"time_unix": round(time.time(), 3), "counters": counters,
-                "gauges": gauges, "histograms": hists}
+            sums = {}
+            for k, s in sorted(self._summaries.items()):
+                q = Summary._quantiles_of(sorted(s._ring))
+                sums[k] = {
+                    "count": s.count, "min": s.min, "max": s.max,
+                    "mean": round(s.sum / s.count, 6) if s.count else None,
+                    "p50": q["p50"], "p90": q["p90"], "p99": q["p99"],
+                    "window": len(s._ring),
+                }
+        out = {"time_unix": round(time.time(), 3), "counters": counters,
+               "gauges": gauges, "histograms": hists}
+        if sums:
+            # additive key: older snapshot consumers (bench telemetry block,
+            # JSONL counters records) ignore it; serving probes read p50/p99
+            out["summaries"] = sums
+        return out
 
     def reset(self) -> None:
         """Drop every metric (tests; a fresh serving epoch)."""
@@ -137,3 +218,4 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+            self._summaries.clear()

@@ -104,6 +104,11 @@ def add_tree_scores(score: jnp.ndarray, tree: TreeArrays, leaf_ids: jnp.ndarray
 
 import numpy as np
 
+# one-time process-wide warning flag for the categorical host fallback in
+# forest_predict_raw (a serving loop over a categorical model must not log
+# per dispatch)
+_CATEGORICAL_FALLBACK = {"warned": False}
+
 
 class StackedForest:
     """Host-built stacked arrays for a list of model-space Trees."""
@@ -159,43 +164,129 @@ class StackedForest:
         self.left = left
         self.right = right
         self.leaf_value = leaf_value
+        # the f64 leaf twin (serving path) builds lazily from the retained
+        # tree list — training-side forests (Booster.predict device route,
+        # bench) never pay its memory/fill cost
+        self._trees = trees
+        self._leaf_value64 = None
         self.root_is_leaf = root_is_leaf
         # rank of literal 0.0 per feature — what a NaN becomes when the node's
         # missing_type is not nan (tree.h:224-227 NaN->0 conversion)
         self.zero_rank = np.array(
             [np.searchsorted(g, 0.0, side="left") for g in self.grids]
             or [0], np.int32)
+        # concatenated offset grid for the one-searchsorted vectorized
+        # encode: grid entries keyed (feature, threshold) as complex128
+        # (real=feature index, imag=threshold) — numpy's complex sort order
+        # is lexicographic with exact float compares on each component, so
+        # ONE searchsorted over the concatenation reproduces every
+        # per-feature searchsorted bit-for-bit (ties and ±inf included; NaN
+        # keys sort to the GLOBAL end under the complex total order and are
+        # patched from the nan mask to the per-feature len(grid) the loop
+        # would produce)
+        self.grid_sizes = np.array([len(g) for g in self.grids], np.int64)
+        self.grid_offsets = np.concatenate(
+            ([0], np.cumsum(self.grid_sizes))).astype(np.int64)
+        total = int(self.grid_offsets[-1]) if len(self.grid_sizes) else 0
+        self._grid_keys = np.empty(total, np.complex128)
+        if total:
+            self._grid_keys.real = np.repeat(
+                np.arange(len(self.grids)), self.grid_sizes)
+            self._grid_keys.imag = np.concatenate(
+                [g for g in self.grids if len(g)])
+        self._feat_iota = np.arange(num_features, dtype=np.float64)
+
+    @property
+    def leaf_value64(self) -> np.ndarray:
+        """f64 twin of ``leaf_value`` for the serving path's host-side
+        accumulation (lightgbm_tpu/serving): the device walk returns leaf
+        INDICES and the engine sums f64 leaf values in tree order —
+        bit-identical to the host predictor's sequential accumulation.
+        Built on first access (serving engines only), cached after."""
+        if self._leaf_value64 is None:
+            lv = np.zeros((self.num_trees, self.leaf_value.shape[1]),
+                          np.float64)
+            for i, t in enumerate(self._trees):
+                if self.root_is_leaf[i]:
+                    lv[i, 0] = t.leaf_value[0] if len(t.leaf_value) else 0.0
+                else:
+                    lv[i, : t.num_leaves] = t.leaf_value[: t.num_leaves]
+            self._leaf_value64 = lv
+        return self._leaf_value64
+
+    # elements (rows*features) below which the vectorized encode wins: one
+    # complex searchsorted beats F Python-level calls up to ~8k elements
+    # (measured: 5.8x at [1, 28], 2.4x at [64, 28], 45x at [1, 137]); past
+    # the crossover the per-feature loop's cheaper float compares win
+    # (~2x at [4096, 28]) and large training-side batches keep it
+    VEC_ENCODE_MAX_ELEMS = 8192
 
     def encode_rows(self, X: np.ndarray):
         """Raw [N, F] float64 -> (rank codes i32, nan mask, zero mask).
 
         c(v) = #{grid thresholds < v} (side='left', f64 on host), so the
         device's integer compare c(v) <= rank(thr) reproduces the float64
-        v <= thr exactly, ties included."""
+        v <= thr exactly, ties included. Small batches (the serving
+        critical path — many concurrent micro-batches) take the one-
+        searchsorted concatenated-grid path; large ones the per-feature
+        loop (see VEC_ENCODE_MAX_ELEMS). Both are parity-pinned against
+        each other in tests/test_serving.py."""
+        N, F = X.shape
+        from ..binning import K_ZERO_RANGE
+        is_nan = np.isnan(X)
+        # missing_type zero treats NaN as 0 first (tree.h:224-227)
+        is_zero = is_nan | (np.abs(np.where(is_nan, 0.0, X)) <= K_ZERO_RANGE)
+        if N * F <= self.VEC_ENCODE_MAX_ELEMS and self._grid_keys.size:
+            codes = self._encode_vectorized(X, is_nan)
+        else:
+            codes = self._encode_loop(X)
+        return codes, is_nan, is_zero
+
+    def _encode_loop(self, X: np.ndarray) -> np.ndarray:
+        """Per-feature searchsorted — the reference implementation the
+        vectorized path is pinned against, and the large-batch winner."""
         N, F = X.shape
         codes = np.zeros((N, F), np.int32)
         for f, grid in enumerate(self.grids):
             if len(grid):
                 codes[:, f] = np.searchsorted(grid, X[:, f], side="left")
-        from ..binning import K_ZERO_RANGE
-        is_nan = np.isnan(X)
-        # missing_type zero treats NaN as 0 first (tree.h:224-227)
-        is_zero = is_nan | (np.abs(np.where(is_nan, 0.0, X)) <= K_ZERO_RANGE)
-        return codes, is_nan, is_zero
+        return codes
+
+    def _encode_vectorized(self, X: np.ndarray, is_nan: np.ndarray
+                           ) -> np.ndarray:
+        """One searchsorted over the concatenated (feature, threshold)
+        offset grid; exact by construction (complex lexicographic compare =
+        feature segment select + float64 threshold compare)."""
+        keys = np.empty(X.shape, np.complex128)
+        keys.real = self._feat_iota[None, :]
+        keys.imag = X
+        flat = np.searchsorted(self._grid_keys, keys.ravel(), side="left")
+        codes = (flat.reshape(X.shape)
+                 - self.grid_offsets[:-1][None, :]).astype(np.int32)
+        if is_nan.any():
+            # complex keys with a NaN component sort past every segment;
+            # restore the loop's per-feature searchsorted(grid, nan) ==
+            # len(grid) so the parity pin holds (the value is semantically
+            # dead — the walk replaces it via zero_rank / the default path)
+            codes[is_nan] = np.broadcast_to(
+                self.grid_sizes[None, :].astype(np.int32), X.shape)[is_nan]
+        return codes
 
 
-@jax.jit
-def _forest_walk(split_feature, thr_rank, decision, left, right, leaf_value,
-                 root_is_leaf, zero_rank, codes, is_nan, is_zero):
-    """Leaf-value sum [N] over all trees; integer-exact traversal.
+def forest_walk_leaves(split_feature, thr_rank, decision, left, right,
+                       root_is_leaf, zero_rank, codes, is_nan, is_zero):
+    """Leaf index [N, T] for every (row, tree); integer-exact traversal.
 
     All T trees advance together: the frontier is [N, T] (trees in the lane
     dimension), so one step is a handful of vectorized gathers instead of a
     per-tree Python/scan loop — the whole forest finishes in max-tree-depth
-    steps."""
+    steps. The serving engine jits THIS variant per batch-size bucket and
+    accumulates f64 leaf values on the host (bit-identical to the host
+    predictor); the training-side ``_forest_walk`` folds the f32 leaf sum
+    on device."""
     T, M = split_feature.shape
     N = codes.shape[0]
-    max_steps = leaf_value.shape[1]
+    max_steps = M + 1                                    # depth <= internals
     t_iota = jnp.arange(T, dtype=jnp.int32)[None, :]               # [1, T]
 
     cur0 = jnp.where(root_is_leaf[None, :], -1, 0).astype(jnp.int32)
@@ -228,22 +319,53 @@ def _forest_walk(split_feature, thr_rank, decision, left, right, leaf_value,
         return cur, steps + 1
 
     cur, _ = jax.lax.while_loop(cond, body, (cur0, jnp.asarray(0, jnp.int32)))
-    leaves = -cur - 1                                              # [N, T]
+    return -cur - 1                                                # [N, T]
+
+
+@jax.jit
+def _forest_walk(split_feature, thr_rank, decision, left, right, leaf_value,
+                 root_is_leaf, zero_rank, codes, is_nan, is_zero):
+    """Leaf-value sum [N] over all trees (f32 accumulation on device) —
+    the training-side batch-predict entry; traversal is
+    ``forest_walk_leaves``."""
+    T = split_feature.shape[0]
+    t_iota = jnp.arange(T, dtype=jnp.int32)[None, :]               # [1, T]
+    leaves = forest_walk_leaves(split_feature, thr_rank, decision, left,
+                                right, root_is_leaf, zero_rank, codes,
+                                is_nan, is_zero)
     return jnp.sum(leaf_value[t_iota, leaves], axis=1)             # [N]
 
 
 def forest_predict_raw(trees, X: np.ndarray, num_features: int,
                        chunk_rows: int = 1 << 16,
                        forest: "StackedForest" = None) -> np.ndarray:
-    """Raw-score batch prediction for a (numerical-split) forest on device.
+    """Raw-score batch prediction for a forest on device.
 
     Returns f64 [N]; traversal is bit-exact vs the host path (integer rank
-    compares), leaf-value accumulation is f32 on device. Pass a prebuilt
-    ``forest`` to amortize the stacking across calls (serving loops)."""
+    compares), leaf-value accumulation is f32 on device. Categorical
+    forests fall back to the host predictor (one-time warning). Pass a
+    prebuilt ``forest`` to amortize the stacking across calls (serving
+    loops)."""
     if forest is None:
         forest = StackedForest(trees, num_features)
     if forest.has_categorical:
-        raise ValueError("categorical splits: use the host predictor")
+        # the rank-encoded device walk covers numerical splits only —
+        # categorical forests fall back to the (vectorized-numpy) host
+        # predictor so every model serves through one entry point
+        # (lightgbm_tpu/serving relies on this); warn ONCE per process
+        if not _CATEGORICAL_FALLBACK["warned"]:
+            _CATEGORICAL_FALLBACK["warned"] = True
+            from ..utils.log import Log
+            Log.warning(
+                "forest holds categorical splits: device batch predict "
+                "covers numerical splits only — routing through the host "
+                "predictor (one-time warning; throughput is the host "
+                "path's)")
+        Xh = np.asarray(X, np.float64)
+        out = np.zeros(Xh.shape[0], np.float64)
+        for t in trees:
+            out += t.predict(Xh)
+        return out
     out = np.zeros(X.shape[0], np.float64)
     dev = [jnp.asarray(a) for a in
            (forest.split_feature, forest.thr_rank, forest.decision,

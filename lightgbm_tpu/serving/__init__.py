@@ -1,0 +1,22 @@
+"""Production inference subsystem (docs/Serving.md).
+
+- ``ServingEngine`` (engine.py)  — load a model from any interchange
+  format (protobuf / text / JSON / in-memory Booster), stack it once,
+  AOT-compile the rank-encoded forest walk per batch-size bucket, and
+  dispatch padded requests with zero steady-state recompiles. Served
+  predictions are bit-identical to ``Booster.predict``.
+- ``MicroBatcher`` (batcher.py)  — thread-safe coalescing of concurrent
+  small ``predict()`` calls into one device dispatch under a max-wait
+  deadline, with per-request de-interleaving of results.
+- load generators (loadgen.py)   — closed-loop and open-loop (Poisson)
+  drivers + latency stats, shared by ``bench.py --serve`` and the CLI's
+  ``task=serve_bench``.
+
+Every request feeds the process-wide metrics registry: ``serve.requests``
+/ ``serve.rows`` counters, ``serve.queue_depth`` gauges,
+``serve.batch_fill_frac`` histogram, and the ``serve.latency_ms`` /
+``serve.dispatch_ms`` quantile summaries whose p50/p99 surface in
+``observability.snapshot()`` — the live serving probe.
+"""
+from .batcher import MicroBatcher                                # noqa: F401
+from .engine import ServingEngine, bucket_ladder                 # noqa: F401

@@ -1,0 +1,158 @@
+"""MicroBatcher: coalesce concurrent small predict() calls into one dispatch.
+
+Million-user traffic is many SMALL concurrent requests; dispatching each
+alone wastes the device on 1-row walks and pays per-dispatch overhead N
+times. The micro-batcher is the classic serving answer (the dynamic
+batching of every production inference server): callers enqueue requests
+from any thread, ONE worker thread coalesces whatever is queued — up to
+``serve_max_batch_rows`` rows, waiting at most ``serve_max_wait_ms`` past
+the oldest request's arrival — into a single engine dispatch, then
+de-interleaves the result rows back to each caller's Future.
+
+Guarantees (pinned by the ordering fuzz in tests/test_serving.py):
+- every caller receives exactly its own rows' predictions, bit-identical
+  to a direct ``engine.predict`` of the same rows (per-row math is
+  independent of what the request was batched with);
+- requests are served FIFO — a request is never passed over by a later
+  one (whole requests are taken from the queue head until the row budget
+  is hit);
+- a worker-side failure is delivered to every affected caller's Future,
+  never swallowed.
+
+Latency accounting: per-request wall-clock (enqueue -> result ready,
+queueing included) feeds the ``serve.latency_ms`` summary; queue depth and
+batch fill fraction land in ``serve.queue_depth`` / ``serve.queue_peak``
+gauges and the ``serve.batch_fill_frac`` histogram.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from .. import observability as obs
+
+
+class _Request:
+    __slots__ = ("X", "raw_score", "future", "t_enq")
+
+    def __init__(self, X, raw_score, t_enq):
+        self.X = X
+        self.raw_score = raw_score
+        self.future: Future = Future()
+        self.t_enq = t_enq
+
+
+class MicroBatcher:
+    """Thread-safe request queue in front of a ``ServingEngine``."""
+
+    def __init__(self, engine, max_batch_rows: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None):
+        self.engine = engine
+        cfg = engine.config
+        self.max_batch_rows = int(max_batch_rows
+                                  if max_batch_rows is not None
+                                  else cfg.serve_max_batch_rows)
+        self.max_wait_s = (max_wait_ms if max_wait_ms is not None
+                           else cfg.serve_max_wait_ms) / 1e3
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._rows_queued = 0
+        self._stop = False
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="lgbm-serve-batcher")
+        self._worker.start()
+
+    # -------------------------------------------------------------- client
+
+    def predict(self, X, raw_score: bool = False) -> np.ndarray:
+        """Enqueue one request and block until its rows come back."""
+        req = _Request(self.engine._as_matrix(X), raw_score, obs.clock())
+        reg = obs.get_registry()
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("MicroBatcher is closed")
+            self._queue.append(req)
+            self._rows_queued += req.X.shape[0]
+            depth = len(self._queue)
+            reg.gauge("serve.queue_depth").set(depth)
+            peak = reg.gauge("serve.queue_peak")
+            if peak.value is None or depth > peak.value:
+                peak.set(depth)
+            self._cv.notify_all()
+        out = req.future.result()
+        reg.counter("serve.requests").inc()
+        reg.summary("serve.latency_ms").observe(
+            (obs.clock() - req.t_enq) * 1e3)
+        return out
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._worker.join(timeout=10.0)
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -------------------------------------------------------------- worker
+
+    def _take_batch(self):
+        """Under the lock: wait for work, hold the coalescing window, pop
+        whole requests FIFO up to the row budget. Returns [] on shutdown."""
+        with self._cv:
+            while not self._queue and not self._stop:
+                self._cv.wait(0.1)
+            if not self._queue:
+                return []
+            deadline = self._queue[0].t_enq + self.max_wait_s
+            while self._rows_queued < self.max_batch_rows and not self._stop:
+                remaining = deadline - obs.clock()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            batch, rows = [], 0
+            while self._queue:
+                n = self._queue[0].X.shape[0]
+                if batch and rows + n > self.max_batch_rows:
+                    break
+                req = self._queue.popleft()
+                batch.append(req)
+                rows += n
+            self._rows_queued -= rows
+            obs.get_registry().gauge("serve.queue_depth").set(
+                len(self._queue))
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                if self._stop:
+                    return
+                continue
+            try:
+                if len(batch) == 1:
+                    Xc = batch[0].X
+                else:
+                    Xc = np.concatenate([r.X for r in batch], axis=0)
+                raw = self.engine._predict_raw(Xc)            # [K, N_total]
+                lo = 0
+                for r in batch:
+                    n = r.X.shape[0]
+                    r.future.set_result(
+                        self.engine._finish(raw[:, lo:lo + n].copy(),
+                                            r.raw_score))
+                    lo += n
+            except BaseException as e:                        # noqa: BLE001
+                # a dispatch failure belongs to the CALLERS — deliver it to
+                # every waiting Future (R010: never swallowed)
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)

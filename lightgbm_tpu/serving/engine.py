@@ -1,0 +1,275 @@
+"""ServingEngine: AOT-compiled, bucket-padded forest inference.
+
+The production inference path (ROADMAP item 4, docs/Serving.md). A model
+loaded from ANY interchange format — protobuf (``io/model_proto.py``, the
+reference fork's headline feature), LightGBM text, JSON dump, or an
+in-memory ``Booster`` — is stacked ONCE into the rank-encoded
+``StackedForest`` arrays (``ops/predict.py``), placed on device once, and
+walked through a per-engine jitted ``forest_walk_leaves`` whose input
+shapes are drawn from a fixed **batch-size bucket ladder**: every request
+is padded up to the smallest bucket that holds it, so million-user traffic
+shapes — many small concurrent batches, never one big one — hit a finite,
+warmed set of executables and NEVER recompile in steady state
+(``bench.py --serve`` pins this under a RecompileGuard). ``warmup()``
+compiles every bucket ahead of serving; with the persistent XLA compile
+cache (``LGBM_TPU_COMPILE_CACHE_DIR``) a restarted server replays the
+compiles from disk.
+
+Numerics contract: traversal is integer-exact on device (rank compares);
+leaf-value accumulation happens on the HOST in float64, sequentially in
+tree order — served predictions are **bit-identical** to the training
+booster's host ``predict()`` (pinned in tests/test_serving.py, including
+the protobuf round trip). The one device->host sync per dispatch — the
+result fetch — is the contract; tpu-lint R011 keeps any other host sync
+out of this package (the sync below is baseline-exempt).
+
+Categorical forests cannot take the rank-encoded walk and serve through
+the host predictor instead (one-time warning from
+``ops/predict.forest_predict_raw`` — same engine API, host throughput).
+
+Observability: every request lands in the process registry —
+``serve.requests``/``serve.rows`` counters, ``serve.batch_fill_frac``
+histogram, ``serve.latency_ms``/``serve.dispatch_ms`` quantile summaries
+whose p50/p99 surface in ``observability.snapshot()`` — and warmup
+captures a cost report per bucket when ``tpu_cost_analysis`` is on.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .. import observability as obs
+from ..config import Config
+from ..utils.log import Log
+
+
+def bucket_ladder(config) -> List[int]:
+    """Resolve the batch-size bucket ladder from config.
+
+    ``serve_buckets`` (comma list, strictly ascending) wins; empty = the
+    powers-of-two ladder 1, 2, 4, ... up to ``serve_max_batch_rows`` —
+    dense enough that padding never exceeds 2x (the batch_fill_frac floor
+    is 0.5)."""
+    if config.serve_buckets:
+        out = [int(v) for v in str(config.serve_buckets).split(",") if v]
+        return out
+    out, b = [], 1
+    while b < config.serve_max_batch_rows:
+        out.append(b)
+        b *= 2
+    out.append(int(config.serve_max_batch_rows))
+    return out
+
+
+class ServingEngine:
+    """Load-once, compile-ahead, dispatch-forever forest inference."""
+
+    def __init__(self, model, params: Optional[Dict] = None,
+                 num_iteration: Optional[int] = None, warmup: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        from ..basic import Booster
+        from ..ops.predict import StackedForest, forest_walk_leaves
+        from ..utils.cache import maybe_enable_compile_cache
+
+        maybe_enable_compile_cache()
+        if isinstance(model, Booster):
+            booster = model
+            if params:
+                booster.config = Config.from_params(
+                    dict(booster.params, **params))
+        else:
+            path = str(model)
+            # serve_* knobs ride in as Booster params; the loader's
+            # apply_model_header merges the file's metadata (objective,
+            # sigmoid, num_class) on top and rebuilds the Config once
+            booster = Booster(params=dict(params or {}))
+            # one format dispatcher: .proto / .json / text all resolve
+            # inside load_model_file
+            from ..io.model_text import load_model_file
+            load_model_file(booster, path)
+        booster._ensure_finalized()
+        self.booster = booster
+        self.config = booster.config
+        K = max(booster.num_model_per_iteration, 1)
+        self.num_class_models = K
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = booster.best_iteration \
+                if booster.best_iteration > 0 else len(booster.trees) // K
+        self.num_iteration = num_iteration
+        self._trees = booster.trees[: num_iteration * K]
+        self.num_features = booster.num_total_features
+
+        self._forests = [StackedForest(self._trees[k::K], self.num_features)
+                         for k in range(K)]
+        self.has_categorical = any(f.has_categorical for f in self._forests)
+        self.buckets = sorted(bucket_ladder(self.config))
+        self.max_bucket = self.buckets[-1]
+        self._dev: List[Tuple] = []
+        if not self.has_categorical:
+            # device residency: the stacked arrays upload ONCE here and are
+            # reused by every dispatch (forest_predict_raw re-uploads per
+            # call — fine for a one-shot batch, wrong for a serving loop)
+            for f in self._forests:
+                self._dev.append(tuple(jnp.asarray(a) for a in (
+                    f.split_feature, f.thr_rank, f.decision, f.left, f.right,
+                    f.root_is_leaf, f.zero_rank)))
+            # per-engine jit: the cache holds exactly this engine's
+            # (class, bucket) signatures, so a RecompileGuard registered on
+            # it pins the zero-recompile serving contract
+            self._walk = jax.jit(forest_walk_leaves)
+        else:
+            self._walk = None
+        reg = obs.get_registry()
+        reg.gauge("serve.buckets").set(len(self.buckets))
+        reg.gauge("serve.max_batch_rows").set(self.max_bucket)
+        reg.gauge("serve.num_trees").set(len(self._trees))
+        self._warm = False
+        if warmup:
+            self.warmup()
+
+    # ------------------------------------------------------------- compile
+
+    def jit_entrypoints(self):
+        """(name, jitted callable) pairs for RecompileGuard registration."""
+        return [] if self._walk is None else [("serve.forest_walk",
+                                               self._walk)]
+
+    def warmup(self) -> int:
+        """AOT-compile the forest walk for every (class, bucket) signature
+        so the first real request — and every one after — dispatches a
+        warm executable. Returns the number of signatures compiled. With
+        the persistent compile cache enabled this replays from disk on
+        restart. Captures a cost report per bucket when cost analysis is
+        on (``cost.serve.forest_walk.b<N>.*`` gauges)."""
+        if self._walk is None or self._warm:
+            return 0
+        from ..observability import costs as obs_costs
+        n = 0
+        with obs.span("serve.warmup", buckets=len(self.buckets)):
+            for k, f in enumerate(self._forests):
+                for B in self.buckets:
+                    codes = np.zeros((B, self.num_features), np.int32)
+                    mask = np.zeros((B, self.num_features), bool)
+                    args = (*self._dev[k], codes, mask, mask)
+                    if obs_costs.enabled():
+                        obs_costs.capture_jit(
+                            f"serve.forest_walk.b{B}", self._walk, args,
+                            dims=dict(rows=B, trees=f.num_trees),
+                            fingerprint=(k, B, self.num_features,
+                                         f.num_trees, int(f.max_leaves)))
+                    # the call compiles synchronously; the async result is
+                    # deliberately dropped — warmup needs the executable,
+                    # not the value
+                    self._walk(*args)
+                    n += 1
+                    obs.inc("serve.bucket_compiles")
+        self._warm = True
+        return n
+
+    # ------------------------------------------------------------ dispatch
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest ladder bucket holding ``n`` rows (requests beyond the
+        top bucket are chunked by the caller)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_bucket
+
+    def _dispatch(self, k: int, codes: np.ndarray, is_nan: np.ndarray,
+                  is_zero: np.ndarray) -> np.ndarray:
+        """One device dispatch of <= max_bucket rows for class ``k``,
+        padded to the bucket: returns leaf indices [n, T]."""
+        n = codes.shape[0]
+        B = self.bucket_for(n)
+        if n < B:
+            pad = B - n
+            codes = np.concatenate(
+                [codes, np.zeros((pad, codes.shape[1]), codes.dtype)])
+            is_nan = np.concatenate(
+                [is_nan, np.zeros((pad, is_nan.shape[1]), bool)])
+            is_zero = np.concatenate(
+                [is_zero, np.zeros((pad, is_zero.shape[1]), bool)])
+        t0 = obs.clock()
+        reg = obs.get_registry()
+        # the contractual result sync: ONE device->host fetch per dispatch
+        # (tpu-lint R011 baseline-exempt; everything else in serving/ stays
+        # sync-free)
+        leaves = np.asarray(self._walk(*self._dev[k], codes, is_nan, is_zero))
+        reg.summary("serve.dispatch_ms").observe((obs.clock() - t0) * 1e3)
+        reg.histogram("serve.batch_fill_frac").observe(n / B)
+        reg.counter(f"serve.bucket.{B}").inc()
+        return leaves[:n]
+
+    def _predict_raw(self, X: np.ndarray) -> np.ndarray:
+        """Raw scores [K, N] f64 for a prepared f64 matrix — traversal on
+        device (bucketed), leaf accumulation on host in f64 tree order
+        (bit-identical to the host predictor)."""
+        N = X.shape[0]
+        K = self.num_class_models
+        raw = np.zeros((K, N), np.float64)
+        if self.has_categorical:
+            for i, t in enumerate(self._trees):
+                raw[i % K] += t.predict(X)
+            obs.get_registry().counter("serve.rows").inc(N)
+            return raw
+        for k, forest in enumerate(self._forests):
+            if forest.num_trees == 0:
+                continue
+            codes, is_nan, is_zero = forest.encode_rows(X)
+            lv = forest.leaf_value64
+            lo = 0
+            while lo < N:
+                n = min(N - lo, self.max_bucket)
+                leaves = self._dispatch(k, codes[lo:lo + n],
+                                        is_nan[lo:lo + n], is_zero[lo:lo + n])
+                # sequential f64 accumulation in tree order — the exact
+                # operation order of Booster.predict's host loop
+                out = raw[k]
+                for t in range(forest.num_trees):
+                    out[lo:lo + n] += lv[t, leaves[:, t]]
+                lo += n
+        obs.get_registry().counter("serve.rows").inc(N)
+        return raw
+
+    def _finish(self, raw: np.ndarray, raw_score: bool) -> np.ndarray:
+        """Output transform — Booster.predict's tail, verbatim semantics."""
+        K = self.num_class_models
+        if self.config.boosting_normalized == "rf":
+            raw = raw / max(len(self._trees) // K, 1)
+        elif not raw_score:
+            raw = self.booster._convert_output(raw)
+        return raw[0] if K == 1 else raw.T
+
+    def predict(self, X, raw_score: bool = False) -> np.ndarray:
+        """Serve one request: [N, F] (or a single row) -> predictions,
+        bit-identical to ``Booster.predict`` on the same rows."""
+        t0 = obs.clock()
+        X = self._as_matrix(X)
+        out = self._finish(self._predict_raw(X), raw_score)
+        reg = obs.get_registry()
+        reg.counter("serve.requests").inc()
+        reg.summary("serve.latency_ms").observe((obs.clock() - t0) * 1e3)
+        return out
+
+    def _as_matrix(self, X) -> np.ndarray:
+        # host input normalization (caller data, not a device value)
+        mat = np.asarray(X, np.float64)
+        if mat.ndim == 1:
+            mat = mat.reshape(1, -1)
+        if mat.shape[1] != self.num_features:
+            raise ValueError(
+                f"request has {mat.shape[1]} features, model expects "
+                f"{self.num_features}")
+        return mat
+
+    def describe(self) -> Dict:
+        return {"buckets": list(self.buckets),
+                "num_trees": len(self._trees),
+                "num_class_models": self.num_class_models,
+                "num_features": self.num_features,
+                "categorical_host_path": self.has_categorical,
+                "warmed": self._warm}

@@ -1,0 +1,16 @@
+"""Fixture: un-sanctioned host syncs in a serving dispatch loop (R011)."""
+import numpy as np
+
+
+def dispatch_loop(walk, dev_args, batches):
+    outs = []
+    for codes in batches:
+        y = walk(*dev_args, codes)
+        y.block_until_ready()          # R011: explicit sync per request
+        outs.append(np.asarray(y))     # R011: materializes the device value
+    return outs
+
+
+def peek_scalar(walk, dev_args, codes):
+    y = walk(*dev_args, codes)
+    return y.item()                    # R011: hidden per-request sync

@@ -317,5 +317,5 @@ def test_bench_comparability_key_carries_n_devices():
     es = ledger.normalize_bench({"value": 1.0, "platform": "cpu",
                                  "rows": 100, "residency": "stream"},
                                 "STREAM_r91.json", 91)
-    assert ledger.comparability_key(es).endswith("|residency=stream")
+    assert "|residency=stream" in ledger.comparability_key(es)
     assert ledger.comparability_key(es) != ledger.comparability_key(e0)

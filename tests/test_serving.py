@@ -1,0 +1,478 @@
+"""Serving subsystem (lightgbm_tpu/serving, docs/Serving.md): interchange
+round trips pinned bit-identical to the training booster, the AOT bucket
+ladder's zero-recompile contract, micro-batcher ordering under concurrent
+load, the vectorized host encode's parity with the per-feature reference,
+and the serve.* observability wiring."""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import observability as obs
+from lightgbm_tpu.ops.predict import StackedForest, forest_predict_raw
+from lightgbm_tpu.serving import MicroBatcher, ServingEngine, bucket_ladder
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs.reset_for_tests()
+    yield
+    obs.reset_for_tests()
+
+
+def _train(objective="binary", n=3000, f=8, trees=20, missing=None,
+           seed=0, **extra):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f) * 4 - 2
+    if missing == "nan":
+        X[rng.rand(n, f) < 0.1] = np.nan
+    elif missing == "zero":
+        X[rng.rand(n, f) < 0.1] = 0.0
+    elif missing == "both":
+        X[rng.rand(n, f) < 0.1] = np.nan
+        X[rng.rand(n, f) < 0.1] = 0.0
+    s = np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) ** 2
+    if objective == "binary":
+        y = (s > np.median(s[np.isfinite(s)])).astype(np.float64)
+    elif objective == "multiclass":
+        y = np.digitize(s, np.quantile(s, [0.33, 0.66])).astype(np.float64)
+    else:
+        y = s + 0.1 * rng.randn(n)
+    params = {"objective": objective, "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 10, "use_missing": missing is not None,
+              **extra}
+    if objective == "multiclass":
+        params["num_class"] = 3
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=trees)
+    return bst, X
+
+
+# ------------------------------------------------------- interchange identity
+
+@pytest.mark.parametrize("objective", ["regression", "binary", "multiclass"])
+def test_proto_roundtrip_bit_identical(tmp_path, objective):
+    """protobuf -> ServingEngine serves BIT-identically to the training
+    booster's in-memory predict() (the acceptance pin)."""
+    bst, X = _train(objective, missing="both")
+    path = str(tmp_path / "m.proto")
+    bst.save_model(path)
+    eng = ServingEngine(path, params={"serve_buckets": "4,32,256",
+                                      "verbose": -1})
+    probe = X[:700]
+    assert np.array_equal(bst.predict(probe), eng.predict(probe))
+    assert np.array_equal(bst.predict(probe, raw_score=True),
+                          eng.predict(probe, raw_score=True))
+
+
+def test_text_and_json_roundtrip_bit_identical(tmp_path):
+    bst, X = _train("binary")
+    p_txt = str(tmp_path / "m.txt")
+    bst.save_model(p_txt)
+    # save_model on a .json name writes the dump_model artifact (the
+    # loader's symmetric half — review finding: it used to write TEXT
+    # under the .json name, breaking its own round trip)
+    p_json = str(tmp_path / "m.json")
+    bst.save_model(p_json)
+    assert json.load(open(p_json))["name"] == "tree"
+    probe = X[:400]
+    want = bst.predict(probe)
+    for path in (p_txt, p_json):
+        eng = ServingEngine(path, params={"serve_buckets": "8,64",
+                                          "verbose": -1})
+        assert np.array_equal(want, eng.predict(probe)), path
+        assert np.array_equal(want, lgb.Booster(model_file=path
+                                                ).predict(probe)), path
+
+
+def test_objective_params_survive_every_format(tmp_path):
+    """A non-default sigmoid must ride through text, proto, AND json —
+    the prediction transform is part of the model (review finding: the
+    JSON dump used to write the bare objective name and a reloaded model
+    silently sigmoided with 1.0)."""
+    bst, X = _train("binary", trees=8, sigmoid=2.5)
+    probe = X[:300]
+    want = bst.predict(probe)
+    paths = {"txt": str(tmp_path / "m.txt"),
+             "proto": str(tmp_path / "m.proto")}
+    for p in paths.values():
+        bst.save_model(p)
+    paths["json"] = str(tmp_path / "m.json")
+    with open(paths["json"], "w") as fh:
+        json.dump(bst.dump_model(), fh)
+    for fmt, p in paths.items():
+        eng = ServingEngine(p, params={"serve_buckets": "64,512",
+                                       "verbose": -1})
+        assert eng.config.sigmoid == 2.5, fmt
+        assert np.array_equal(want, eng.predict(probe)), fmt
+
+
+def test_engine_from_in_memory_booster():
+    bst, X = _train("regression")
+    eng = ServingEngine(bst, params={"serve_buckets": "8,64", "verbose": -1})
+    assert np.array_equal(bst.predict(X[:200]), eng.predict(X[:200]))
+    # single row (the 1-row serving shape)
+    assert np.array_equal(bst.predict(X[:1]), eng.predict(X[0]))
+
+
+def test_categorical_model_serves_via_host_path(tmp_path):
+    """Categorical forests route through the host predictor (one-time
+    warning) — same engine API, identical predictions (satellite 2)."""
+    rng = np.random.RandomState(3)
+    n = 2000
+    Xc = np.column_stack([rng.randint(0, 6, size=n).astype(np.float64),
+                          rng.rand(n) * 4 - 2, rng.rand(n) * 2])
+    y = (Xc[:, 0] % 2 == 0).astype(np.float64) * 2 + Xc[:, 1]
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 10,
+                     "max_cat_to_onehot": 2},
+                    lgb.Dataset(Xc, label=y, categorical_feature=[0]),
+                    num_boost_round=15)
+    assert any((np.asarray(t.decision_type) & 1).any() for t in bst.trees)
+    path = str(tmp_path / "m.proto")
+    bst.save_model(path)
+    eng = ServingEngine(path, params={"verbose": -1})
+    assert eng.has_categorical
+    assert np.array_equal(bst.predict(Xc[:300]), eng.predict(Xc[:300]))
+    # the device entry point also falls back (no raise), host-exact
+    dev = forest_predict_raw(bst.trees, Xc[:50], bst.num_total_features)
+    host = np.zeros(50)
+    for t in bst.trees:
+        host += t.predict(np.asarray(Xc[:50], np.float64))
+    assert np.array_equal(dev, host)
+
+
+# ------------------------------------------------------- buckets / recompiles
+
+def test_bucket_ladder_auto_pads_at_most_2x():
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params({"serve_max_batch_rows": 4096, "verbose": -1})
+    ladder = bucket_ladder(cfg)
+    assert ladder[0] == 1 and ladder[-1] == 4096
+    for n in (1, 2, 3, 5, 17, 100, 1000, 4096):
+        b = next(x for x in ladder if x >= n)
+        assert b < 2 * n or n == 1
+
+
+def test_bucket_for_and_chunking():
+    bst, X = _train("regression", trees=5)
+    eng = ServingEngine(bst, params={"serve_buckets": "4,16", "verbose": -1})
+    assert eng.bucket_for(1) == 4
+    assert eng.bucket_for(5) == 16
+    assert eng.bucket_for(16) == 16
+    assert eng.bucket_for(999) == 16     # caller chunks by max bucket
+    # a request far beyond the top bucket still serves (chunked) and is
+    # bit-identical to the booster
+    assert np.array_equal(bst.predict(X[:100]), eng.predict(X[:100]))
+
+
+def test_no_recompiles_after_warmup_across_sizes():
+    """Every request size within the ladder dispatches a warmed executable
+    — zero jit cache misses after warmup() (the serving contract)."""
+    from lightgbm_tpu.analysis.guards import RecompileGuard
+    bst, X = _train("binary", trees=10)
+    eng = ServingEngine(bst, params={"serve_buckets": "2,8,32", "verbose": -1})
+    guard = RecompileGuard(label="serve-test")
+    for name, fn in eng.jit_entrypoints():
+        guard.register(fn, name)
+    with guard:
+        guard.mark_warm()
+        for n in (1, 2, 3, 7, 8, 9, 31, 32, 33, 100):
+            eng.predict(X[:n])
+    assert sum(guard.cache_misses_since_warm().values()) == 0
+
+
+def test_serve_config_knobs_validated():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        Config.from_params({"serve_max_batch_rows": 0})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"serve_max_wait_ms": -1})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"serve_buckets": "8,4"})    # not ascending
+    with pytest.raises((LightGBMError, ValueError)):
+        Config.from_params({"serve_buckets": "a,b"})
+    with pytest.raises(LightGBMError):   # top entry above the dispatch cap
+        Config.from_params({"serve_buckets": "1,8192",
+                            "serve_max_batch_rows": 4096})
+    cfg = Config.from_params({"serve_buckets": "1,8,64", "verbose": -1})
+    assert bucket_ladder(cfg) == [1, 8, 64]
+
+
+def test_loadgen_rows_count_capped_at_pool():
+    """rows/s counts rows actually served: when batch_rows exceeds the
+    pool, _request_slices serves the whole pool per request and the
+    throughput math must not credit the requested batch size."""
+    from lightgbm_tpu.serving.loadgen import run_closed_loop, run_open_loop
+    X = np.zeros((10, 3))
+    served = []
+    r = run_closed_loop(lambda Xr: served.append(Xr.shape[0]), X,
+                        batch_rows=512, concurrency=2,
+                        requests_per_worker=3)
+    assert set(served) == {10} and r["batch_rows_effective"] == 10
+    assert r["rows_per_s"] <= 1.05 * 10 * r["requests"] / r["wall_s"]
+    r = run_open_loop(lambda Xr: None, X, batch_rows=512,
+                      rate_rps=200.0, duration_s=0.05, seed=0)
+    assert r["batch_rows_effective"] == 10
+    # within the pool nothing changes: no _effective key emitted
+    r = run_closed_loop(lambda Xr: None, X, batch_rows=4, concurrency=1,
+                        requests_per_worker=2)
+    assert "batch_rows_effective" not in r and r["batch_rows"] == 4
+
+
+# ------------------------------------------------------------- micro-batcher
+
+def test_microbatcher_ordering_fuzz():
+    """Concurrent requests of random sizes each get exactly their own rows
+    back, bit-identical to a direct engine.predict (the de-interleaving
+    pin; rides make verify)."""
+    bst, X = _train("binary", trees=10)
+    eng = ServingEngine(bst, params={"serve_buckets": "4,32,128",
+                                     "verbose": -1})
+    rng = np.random.RandomState(0)
+    jobs = [(int(rng.randint(0, 2500)), int(rng.randint(1, 40)))
+            for _ in range(64)]
+    outs = {}
+    with MicroBatcher(eng, max_batch_rows=128, max_wait_ms=2.0) as mb:
+        def call(i, lo, n):
+            outs[i] = mb.predict(X[lo:lo + n])
+        threads = [threading.Thread(target=call, args=(i, lo, n))
+                   for i, (lo, n) in enumerate(jobs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for i, (lo, n) in enumerate(jobs):
+        assert np.array_equal(outs[i], eng.predict(X[lo:lo + n])), i
+
+
+def test_microbatcher_deadline_flush_and_errors():
+    bst, X = _train("regression", trees=5)
+    eng = ServingEngine(bst, params={"serve_buckets": "4,16", "verbose": -1})
+    # a lone request must not wait forever for companions
+    with MicroBatcher(eng, max_batch_rows=1 << 14, max_wait_ms=5.0) as mb:
+        out = mb.predict(X[:3])
+        assert np.array_equal(out, eng.predict(X[:3]))
+        # a worker-side failure is delivered to the caller, not swallowed
+        with pytest.raises(ValueError):
+            mb.predict(np.zeros((2, X.shape[1] + 5)))
+    with pytest.raises(RuntimeError):
+        mb.predict(X[:1])                    # closed batcher refuses
+
+
+# ------------------------------------------------------------- encode parity
+
+def _forest_for_encode(trees=25, f=10, seed=1):
+    bst, X = _train("regression", f=f, trees=trees, missing="both",
+                    seed=seed)
+    return StackedForest(bst.trees, bst.num_total_features)
+
+
+def test_encode_rows_vectorized_matches_loop():
+    """The one-searchsorted concatenated-grid encode is bit-identical to
+    the per-feature loop: ties, NaN, zero-range, ±inf, -0.0, empty grids
+    (satellite 1)."""
+    forest = _forest_for_encode()
+    rng = np.random.RandomState(7)
+    X = rng.randn(500, 10) * 3
+    X[rng.rand(500, 10) < 0.15] = np.nan
+    X[rng.rand(500, 10) < 0.15] = 0.0
+    X[rng.rand(500, 10) < 0.05] = np.inf
+    X[rng.rand(500, 10) < 0.05] = -np.inf
+    X[0, 0] = -0.0
+    # exact threshold ties on every non-empty grid
+    for f, g in enumerate(forest.grids):
+        if len(g):
+            X[1, f] = g[0]
+            X[2, f] = g[-1]
+            X[3, f] = g[len(g) // 2]
+    vec = forest._encode_vectorized(X, np.isnan(X))
+    loop = forest._encode_loop(X)
+    np.testing.assert_array_equal(vec, loop)
+
+
+def test_encode_rows_selects_by_size_and_agrees():
+    forest = _forest_for_encode()
+    rng = np.random.RandomState(8)
+    for n in (1, 13, 400, 3000):    # spans the VEC_ENCODE_MAX_ELEMS cut
+        X = rng.randn(n, 10)
+        X[rng.rand(n, 10) < 0.1] = np.nan
+        codes, is_nan, is_zero = forest.encode_rows(X)
+        np.testing.assert_array_equal(codes, forest._encode_loop(X))
+        np.testing.assert_array_equal(is_nan, np.isnan(X))
+
+
+# ------------------------------------------------- device-vs-host parity suite
+
+@pytest.mark.parametrize("missing", [None, "zero", "nan", "both"])
+def test_device_predict_parity_missing_types(missing):
+    """Device walk === host predictor across missing-value regimes
+    (satellite 3); zero_as_missing exercises missing_type=zero nodes."""
+    extra = {"zero_as_missing": True} if missing == "zero" else {}
+    bst, X = _train("regression", trees=15, missing=missing, seed=5, **extra)
+    eng = ServingEngine(bst, params={"serve_buckets": "16,128",
+                                     "verbose": -1})
+    host = np.zeros(600)
+    Xp = np.asarray(X[:600], np.float64)
+    for t in bst.trees:
+        host += t.predict(Xp)
+    served = eng.predict(Xp, raw_score=True)
+    assert np.array_equal(served, host)
+
+
+def test_device_predict_parity_threshold_ties():
+    """Rows planted exactly ON split thresholds traverse identically on
+    device and host (the rank encoding's reason to exist)."""
+    bst, X = _train("regression", trees=10, seed=6)
+    thr = sorted({float(v) for t in bst.trees
+                  for v in t.threshold[: t.num_internal]})
+    assert thr, "model has no splits to tie against"
+    rng = np.random.RandomState(0)
+    Xt = rng.rand(len(thr) * 4, X.shape[1]) * 4 - 2
+    for i, v in enumerate(thr):
+        for t in bst.trees[:4]:
+            for n in range(t.num_internal):
+                if float(t.threshold[n]) == v:
+                    Xt[4 * i + (n % 4), t.split_feature[n]] = v
+    eng = ServingEngine(bst, params={"serve_buckets": "64,256",
+                                     "verbose": -1})
+    host = np.zeros(Xt.shape[0])
+    for t in bst.trees:
+        host += t.predict(Xt)
+    assert np.array_equal(eng.predict(Xt, raw_score=True), host)
+
+
+def test_root_is_leaf_trees_serve():
+    """Constant trees (num_leaves==1) serve: the walk settles immediately
+    (root_is_leaf) and the f64 leaf constant accumulates in order."""
+    from lightgbm_tpu.tree import Tree
+    bst, X = _train("regression", trees=8, seed=9)
+    const = Tree(
+        num_leaves=1,
+        split_feature=np.zeros(0, np.int32),
+        threshold_bin=np.zeros(0, np.int32),
+        threshold=np.zeros(0, np.float64),
+        decision_type=np.zeros(0, np.uint8),
+        left_child=np.zeros(0, np.int32),
+        right_child=np.zeros(0, np.int32),
+        split_gain=np.zeros(0, np.float64),
+        internal_value=np.zeros(0, np.float64),
+        internal_count=np.zeros(0, np.int64),
+        leaf_value=np.array([3.25]),
+        leaf_count=np.array([500], np.int64),
+        leaf_parent=np.full(1, -1, np.int32))
+    bst.trees = bst.trees + [const]
+    bst._forest_rev += 1
+    bst.free_dataset()              # freeze the hand-edited forest
+    eng = ServingEngine(bst, params={"serve_buckets": "8,64", "verbose": -1})
+    host = np.zeros(100)
+    Xp = np.asarray(X[:100], np.float64)
+    for t in bst.trees:
+        host += t.predict(Xp)
+    assert np.array_equal(eng.predict(Xp, raw_score=True), host)
+    assert np.array_equal(bst.predict(Xp), eng.predict(Xp))
+
+
+# ------------------------------------------------------------- observability
+
+def test_serve_metrics_and_snapshot_p50_p99():
+    bst, X = _train("binary", trees=8)
+    eng = ServingEngine(bst, params={"serve_buckets": "4,16", "verbose": -1})
+    for n in (1, 3, 9, 16, 5):
+        eng.predict(X[:n])
+    snap = obs.snapshot()
+    c = snap["counters"]
+    assert c["serve.requests"] == 5
+    assert c["serve.rows"] == 34
+    assert c["serve.bucket_compiles"] == 2
+    assert c["serve.bucket.4"] >= 2 and c["serve.bucket.16"] >= 3
+    lat = snap["summaries"]["serve.latency_ms"]
+    assert lat["count"] == 5 and lat["p50"] is not None \
+        and lat["p99"] is not None and lat["p99"] >= lat["p50"]
+    fill = snap["histograms"]["serve.batch_fill_frac"]
+    assert fill["count"] >= 5 and 0 < fill["mean"] <= 1.0
+    disp = snap["summaries"]["serve.dispatch_ms"]
+    assert disp["count"] >= 5
+
+
+def test_summary_quantiles_nearest_rank():
+    from lightgbm_tpu.observability.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    s = reg.summary("x", window=100)
+    for v in range(1, 101):                      # 1..100
+        s.observe(float(v))
+    q = s.quantiles()
+    assert q["p50"] == 50.0 and q["p90"] == 90.0 and q["p99"] == 99.0
+    snap = reg.snapshot()
+    assert snap["summaries"]["x"]["p99"] == 99.0
+    assert snap["summaries"]["x"]["count"] == 100
+    # window wraps: old observations age out
+    for v in range(1000, 1100):
+        s.observe(float(v))
+    assert s.quantiles()["p50"] >= 1000
+
+
+def test_warmup_captures_cost_reports_per_bucket():
+    from lightgbm_tpu.observability import costs
+    bst, X = _train("regression", trees=5)
+    costs.configure(enabled=True)
+    try:
+        ServingEngine(bst, params={"serve_buckets": "4,16", "verbose": -1})
+        reports = costs.reports()
+    finally:
+        costs.configure(enabled=False)
+    assert "serve.forest_walk.b4" in reports
+    assert "serve.forest_walk.b16" in reports
+
+
+# ------------------------------------------------------------------ CLI task
+
+def test_cli_serve_bench_task(tmp_path, capsys):
+    bst, X = _train("binary", trees=5, n=400)
+    model = str(tmp_path / "m.proto")
+    bst.save_model(model)
+    data = str(tmp_path / "req.csv")
+    np.savetxt(data, np.column_stack([np.zeros(len(X))[:200], X[:200]]),
+               delimiter=",")
+    from lightgbm_tpu.cli import main as cli_main
+    rc = cli_main(["task=serve_bench", f"input_model={model}",
+                   f"data={data}", "serve_buckets=1,8,64", "verbose=-1"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rep = json.loads(line)
+    assert rep["task"] == "serve_bench"
+    shape = next(iter(rep["shapes"].values()))
+    assert shape["p50_ms"] is not None and shape["p99_ms"] is not None \
+        and shape["rows_per_s"] > 0
+
+
+# ------------------------------------------------------------------- ledger
+
+def test_ledger_serve_key_and_p99_gate():
+    from lightgbm_tpu.observability import ledger
+    serve = {"metric": "serve_bench", "value": 50000.0, "unit": "rows/s",
+             "platform": "cpu", "rows": 20000, "kernel": "xla",
+             "n_devices": 1, "serve": "closed|b512xc2", "p99_ms": 40.0,
+             "recompiles_post_warmup": 0}
+    e = ledger.normalize_bench(serve, "SERVE_r01.json", 1)
+    assert e["serve"] == "closed|b512xc2" and e["p99_ms"] == 40.0
+    key = ledger.comparability_key(e)
+    assert key.endswith("|serve=closed|b512xc2")
+    train_e = ledger.normalize_bench(
+        {"metric": "bench", "value": 6.0, "platform": "cpu",
+         "rows": 20000, "kernel": "xla", "n_devices": 1}, "BENCH_rX.json", 9)
+    assert ledger.comparability_key(train_e) != key
+    # rows/s regression fails; p99 regression fails; in-band passes
+    hist = [e]
+    bad_tp = dict(serve, value=1000.0)
+    problems, _ = ledger.compare(bad_tp, hist)
+    assert any("throughput regression" in p for p in problems)
+    bad_p99 = dict(serve, p99_ms=400.0)
+    problems, _ = ledger.compare(bad_p99, hist)
+    assert any("p99 latency regression" in p for p in problems)
+    good = dict(serve, value=51000.0, p99_ms=41.0)
+    problems, _ = ledger.compare(good, hist)
+    assert problems == []

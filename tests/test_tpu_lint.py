@@ -30,6 +30,7 @@ BAD_FIXTURES = [
     (os.path.join("lightgbm_tpu", "bad_r008.py"), "R008"),
     ("bad_r009.py", "R009"),
     (os.path.join("lightgbm_tpu", "bad_r010.py"), "R010"),
+    (os.path.join("lightgbm_tpu", "serving", "bad_r011.py"), "R011"),
 ]
 
 
@@ -201,6 +202,59 @@ def test_r009_fires_on_from_import_alias(tmp_path):
     assert err is None
     assert {f.rule for f in findings} == {"R009"}, \
         [f.format() for f in findings]
+
+
+def test_r011_scoped_to_serving_and_input_normalization_is_clean(tmp_path):
+    """R011 only patrols lightgbm_tpu/serving/: the identical sync outside
+    that tree is another rule's business, and inside it plain input
+    normalization (np.asarray on a caller-provided parameter) stays
+    legal — only just-computed (plausibly device) values are flagged."""
+    src = ("import numpy as np\n\n\n"
+           "def normalize(X):\n"
+           "    mat = np.asarray(X, np.float64)\n"
+           "    return mat\n\n\n"
+           "def batch(parts):\n"
+           "    return np.concatenate(parts)\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings, err = lint_file(str(p), rel="lightgbm_tpu/serving/mod.py")
+    assert err is None
+    assert [f for f in findings if f.rule == "R011"] == [], \
+        [f.format() for f in findings]
+    # same sync-y code outside serving/ -> out of R011's scope
+    bad = ("import numpy as np\n\n\n"
+           "def fetch(walk, args):\n"
+           "    y = walk(*args)\n"
+           "    return np.asarray(y)\n")
+    p2 = tmp_path / "mod2.py"
+    p2.write_text(bad)
+    findings, err = lint_file(str(p2), rel="lightgbm_tpu/ops/mod2.py")
+    assert err is None
+    assert [f for f in findings if f.rule == "R011"] == []
+    findings, err = lint_file(str(p2), rel="lightgbm_tpu/serving/mod2.py")
+    assert err is None
+    assert len([f for f in findings if f.rule == "R011"]) == 1
+
+
+def test_r011_contractual_result_sync_is_baseline_exempt():
+    """ServingEngine._dispatch's single result fetch — the serving path's
+    one contractual device->host sync — is seen by R011 and absorbed by
+    the committed baseline; any NEW sync in serving/ fails the lint."""
+    findings, err = lint_file(
+        os.path.join(REPO, "lightgbm_tpu", "serving", "engine.py"),
+        rel="lightgbm_tpu/serving/engine.py")
+    assert err is None
+    r011 = [f for f in findings if f.rule == "R011"]
+    assert len(r011) == 1 and "np.asarray" in r011[0].snippet
+    bl = Baseline.load(os.path.join(REPO, "tpu_lint_baseline.json"))
+    assert bl.suppresses(r011[0])
+    # the batcher and load generators are sync-free by construction
+    for mod in ("batcher.py", "loadgen.py", "__init__.py"):
+        findings, err = lint_file(
+            os.path.join(REPO, "lightgbm_tpu", "serving", mod),
+            rel=f"lightgbm_tpu/serving/{mod}")
+        assert err is None
+        assert [f for f in findings if f.rule == "R011"] == [], mod
 
 
 def test_clean_fixture_has_no_findings():
