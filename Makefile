@@ -10,7 +10,7 @@
 # compile cache in .jax_cache; `check-fast` is ~4 min cold.
 PYTEST := python -m pytest -q
 
-# Static JAX/TPU hygiene pass (rules R001-R011, see docs/Static-Analysis.md).
+# Static JAX/TPU hygiene pass (rules R001-R012, see docs/Static-Analysis.md).
 # Exits non-zero on any finding not covered by tpu_lint_baseline.json.
 lint:
 	python -m lightgbm_tpu.analysis lightgbm_tpu/
@@ -22,7 +22,10 @@ lint:
 # stream smoke (small N, forced budget -> tpu_residency=stream; asserts 0
 # recompiles and bit-identity with the resident output) + the serving
 # smoke (protobuf -> ServingEngine bit-identity, 0 recompiles across the
-# bucket ladder under load) + the perf-ledger diff. The FAST chaos-matrix arms (corrupt-latest lineage fallback across
+# bucket ladder under load) + the serving-resilience chaos matrix (make
+# serve-chaos: overload shed / breaker degrade-recover / deadline hang /
+# mid-load reload, all typed + bit-identical) + the perf-ledger diff. The
+# FAST chaos-matrix arms (corrupt-latest lineage fallback across
 # serial/data8/stream, watchdog fake-clock boundaries, shard-CRC
 # detection, supervisor policy) ride inside the tier-1 line — only the
 # slow supervised kill -9 / hang / shard-restart arms are deferred to
@@ -32,6 +35,7 @@ verify: lint
 	python bench.py --smoke
 	$(MAKE) stream
 	$(MAKE) serve
+	$(MAKE) serve-chaos
 	$(MAKE) bench-diff
 
 # Out-of-core streaming smoke (docs/TPU-Performance.md "Out-of-core
@@ -53,6 +57,18 @@ stream:
 # LGBM_TPU_SERVE_OUT=SERVE_r<N>.json.
 serve:
 	env LGBM_TPU_SERVE_ROWS=20000 python bench.py --serve
+
+# Serving-resilience chaos matrix (docs/Serving.md "Resilience"): overload
+# burst against the bounded queue (typed sheds, never a hang or OOM),
+# injected dispatch failures (circuit breaker -> degraded host serving ->
+# probe recovery to ready), a slow-dispatch hang under per-request
+# deadlines (callers unblock at THEIR deadline; expired requests never
+# cost a dispatch), a mid-load hot reload (atomic, verified, rolled back
+# on a corrupted candidate), and a final 0-recompile steady-state pin —
+# every arm asserting bit-identity wherever a result is produced. Bank
+# with LGBM_TPU_SERVE_CHAOS_OUT=SERVE_CHAOS_r<N>.json.
+serve-chaos:
+	env LGBM_TPU_SERVE_CHAOS_ROWS=8000 python bench.py --serve-chaos
 
 # Perf regression gate (docs/TPU-Performance.md): assert the committed
 # PERF_LEDGER.json matches the checked-in BENCH_*/MULTICHIP_* history (no
@@ -121,4 +137,4 @@ trace:
 	@echo "trace: $$(ls -1t .telemetry/trace_*.json | head -1)"
 
 .PHONY: lint verify check-fast check capi bench-cpu chaos bench-chaos \
-        trace bench-diff ledger multichip stream serve
+        trace bench-diff ledger multichip stream serve serve-chaos

@@ -1695,6 +1695,319 @@ def run_serve(argv=None):
     return 0 if ok else 1
 
 
+# ------------------------------------------------------- serve-chaos phase
+
+def run_serve_chaos(argv=None):
+    """`bench.py --serve-chaos`: the serving-resilience phase
+    (docs/Serving.md "Resilience", serving/resilience.py). Hermetic CPU,
+    deterministic fault injection through the engine's DispatchChaos hook
+    — injected faults travel the exact production dispatch path. Arms:
+
+    1. OVERLOAD BURST — an open-loop Poisson arrival stream offered ABOVE
+       capacity (every dispatch artificially slowed) against a bounded
+       micro-batcher queue: excess requests SHED with the typed
+       ServerOverloadedError (never queued, never OOM, never a hang),
+       every served response is verified bit-identical to the training
+       booster, and the shed rate + p99-under-overload are the banked
+       headline the perf ledger gates (`|serve_chaos=` key).
+    2. DISPATCH FAILURES — an injected failure burst trips the circuit
+       breaker: requests DURING the burst still answer bit-identically
+       (host-predictor fallback), health() reads `degraded`, and the
+       background probe re-warms the device path back to `ready`.
+    3. SLOW-DISPATCH HANG — a wedged dispatch under per-request
+       deadlines: every waiting caller unblocks with DeadlineExceededError
+       at ~its deadline (never the hang duration), queued requests behind
+       the hang are dropped at dequeue WITHOUT spending a dispatch, and
+       serving recovers bit-identically once the hang clears.
+    4. MID-LOAD RELOAD — a hot reload() swaps models under open-loop
+       traffic: zero request errors, every response matches exactly ONE
+       of the two model versions; a deliberately corrupted candidate
+       (injected verify failure) ROLLS BACK leaving the live version
+       serving.
+    5. STEADY-STATE PIN — after all chaos, a RecompileGuard over the
+       engine's entrypoints proves resilience adds ZERO steady-state
+       recompiles.
+
+    Prints ONE JSON line (bench schema; `serve_chaos` names the
+    fault-injection shape for the ledger, `shed_rate` and `p99_ms` feed
+    the regression gate); exit 0 iff every arm holds.
+    LGBM_TPU_SERVE_CHAOS_OUT banks the payload as SERVE_CHAOS_r<N>.json."""
+    from lightgbm_tpu.utils.hermetic import force_cpu_backend
+    force_cpu_backend()
+    import threading
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import observability as obs
+    from lightgbm_tpu.analysis.guards import GuardViolation, RecompileGuard
+    from lightgbm_tpu.serving import (DeadlineExceededError, DispatchChaos,
+                                      MicroBatcher, ReloadError,
+                                      ServingEngine)
+    from lightgbm_tpu.serving.loadgen import run_open_loop
+
+    n_rows = int(os.environ.get("LGBM_TPU_SERVE_CHAOS_ROWS", "8000"))
+    n_trees = int(os.environ.get("LGBM_TPU_SERVE_CHAOS_TREES", "20"))
+    X, y = _higgs_like(n_rows)
+    common = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20, "verbose": -1,
+              "metric": "none"}
+    bst = lgb.train(dict(common, seed=7), lgb.Dataset(X, label=y),
+                    num_boost_round=n_trees)
+    bst2 = lgb.train(dict(common, seed=11, num_leaves=15),
+                     lgb.Dataset(X, label=y),
+                     num_boost_round=max(n_trees // 2, 4))
+
+    out = {"metric": "serve_chaos", "unit": "rows/s", "platform": "cpu",
+           "rows": n_rows, "kernel": "xla", "n_devices": 1,
+           "trees": n_trees, "serve_chaos": "open|b4|overload"}
+    ok, err = True, []
+
+    engine = ServingEngine(
+        bst, params={"serve_buckets": "1,8,64", "serve_max_batch_rows": 64,
+                     "serve_max_wait_ms": 1.0, "serve_breaker_failures": 3,
+                     "serve_breaker_window_s": 30.0,
+                     "serve_probe_interval_s": 0.05, "verbose": -1})
+    chaos = DispatchChaos()
+    engine.chaos = chaos
+    probe = X[:256]
+    want = bst.predict(probe)
+
+    # ---- arm 1: overload burst sheds, never hangs, served bits exact ----
+    # capacity is capped (every dispatch slowed) so the offered Poisson
+    # load genuinely exceeds it; overload clients carry deadlines (the
+    # real serving shape — without one a caller camps on the saturated
+    # replica instead of letting admission control shed it)
+    chaos.slowdown_s = 0.05
+    mismatches = [0]
+
+    def predict_checked(Xr):
+        served = mb.predict(Xr)
+        if not np.array_equal(served, bst.predict(Xr)):
+            mismatches[0] += 1
+            raise AssertionError("served bits differ under overload")
+        return served
+
+    t_arm = time.monotonic()
+    with MicroBatcher(engine, max_batch_rows=64, max_wait_ms=1.0,
+                      max_queue_rows=64, deadline_ms=500.0) as mb:
+        r = run_open_loop(predict_checked, X[:512], batch_rows=4,
+                          rate_rps=float(os.environ.get(
+                              "LGBM_TPU_SERVE_CHAOS_RPS", "600")),
+                          duration_s=2.0, seed=13, stop_on_error=False)
+    chaos.slowdown_s = 0.0
+    arm_wall = time.monotonic() - t_arm
+    sheds = sum("ServerOverloadedError" in e for e in r["errors"])
+    deadlines = sum("DeadlineExceededError" in e for e in r["errors"])
+    other = [e for e in r["errors"]
+             if "ServerOverloadedError" not in e
+             and "DeadlineExceededError" not in e]
+    offered = r["requests"] + len(r["errors"])
+    shed_rate = round(sheds / offered, 4) if offered else None
+    out["overload"] = {
+        "offered_rps": r["offered_rps"], "requests_offered": offered,
+        "served": r["requests"], "shed": sheds,
+        "deadline_exceeded": deadlines, "shed_rate": shed_rate,
+        "p50_ms": r["p50_ms"], "p99_ms": r["p99_ms"],
+        "rows_per_s": r["rows_per_s"], "wall_s": round(arm_wall, 2),
+        "other_errors": other[:3]}
+    out["shed_rate"] = shed_rate
+    out["value"] = r["rows_per_s"]
+    out["p99_ms"] = r["p99_ms"]
+    out["p50_ms"] = r["p50_ms"]
+    if sheds == 0:
+        ok = False
+        err.append("overload arm: offered load above capacity but nothing "
+                   "was shed — admission control did not engage")
+    if r["requests"] == 0:
+        ok = False
+        err.append("overload arm: nothing was served — shedding must "
+                   "protect capacity, not replace it")
+    if other or mismatches[0]:
+        ok = False
+        err.append(f"overload arm: unexpected non-typed errors {other[:2]} "
+                   f"(+{mismatches[0]} bit mismatches)")
+    if arm_wall > 60.0:
+        ok = False
+        err.append(f"overload arm took {arm_wall:.0f}s — a bounded queue "
+                   f"with deadlines must not stall the drivers")
+
+    # ---- arm 2: dispatch failures -> degraded -> probe recovery ---------
+    # 3 failures trip the breaker; the surplus keeps the PROBE failing too
+    # (injected faults travel every dispatch), holding the engine
+    # observably degraded while the latency arm runs — recovery follows
+    # once the injected burst exhausts
+    chaos.arm_failures(23)
+    degraded_ok = True
+    for _ in range(3):
+        degraded_ok &= bool(np.array_equal(engine.predict(probe), want))
+    health_mid = engine.health()
+    t0 = obs.clock()
+    lat_deg = []
+    for _ in range(20):
+        t1 = obs.clock()
+        degraded_ok &= bool(np.array_equal(engine.predict(probe), want))
+        lat_deg.append((obs.clock() - t1) * 1e3)
+    from lightgbm_tpu.serving.loadgen import latency_stats
+    deg_stats = latency_stats(lat_deg)
+    t_rec = obs.clock()
+    while engine.health() != "ready" and obs.clock() - t_rec < 15.0:
+        time.sleep(0.05)
+    recovered = engine.health() == "ready"
+    post_ok = bool(np.array_equal(engine.predict(probe), want))
+    out["degraded"] = {
+        "health_during_burst": health_mid, "bit_identical": degraded_ok,
+        "p99_ms": deg_stats["p99_ms"], "recovered_ready": recovered,
+        "recovery_s": round(obs.clock() - t0, 3),
+        "bit_identical_after_recovery": post_ok}
+    if not (health_mid == "degraded" and degraded_ok and recovered
+            and post_ok):
+        ok = False
+        err.append(f"degrade arm failed: {out['degraded']}")
+
+    # ---- arm 3: slow-dispatch hang under deadlines ----------------------
+    chaos.arm_hang(1.5, n=1)
+    outcomes = []
+    with MicroBatcher(engine, max_batch_rows=8, max_wait_ms=1.0,
+                      deadline_ms=200.0) as mb:
+        d0 = chaos.dispatches
+
+        def call():
+            t1 = obs.clock()
+            try:
+                mb.predict(X[:2])
+                outcomes.append(("ok", obs.clock() - t1))
+            except DeadlineExceededError:
+                outcomes.append(("deadline", obs.clock() - t1))
+            except Exception as e:                            # noqa: BLE001
+                outcomes.append((repr(e), obs.clock() - t1))
+
+        threads = [threading.Thread(target=call, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+            time.sleep(0.08)
+        for t in threads:
+            t.join(timeout=20)
+        hang_dispatches = chaos.dispatches - d0
+        time.sleep(1.3)            # let the hung dispatch clear
+        post = mb.predict(X[:5])
+    hang_ok = (len(outcomes) == 3
+               and all(k == "deadline" for k, _ in outcomes)
+               and all(dt < 1.2 for _, dt in outcomes)
+               and hang_dispatches == 1
+               and np.array_equal(post, bst.predict(X[:5])))
+    out["hang"] = {"outcomes": [(k, round(dt, 3)) for k, dt in outcomes],
+                   "dispatches_spent": hang_dispatches,
+                   "recovered_bit_identical": hang_ok}
+    if not hang_ok:
+        ok = False
+        err.append(f"hang arm failed: {out['hang']}")
+
+    # ---- arm 4: mid-load reload (atomic) + corrupted-candidate rollback -
+    pool = X[:40]
+    exp1 = {n: bst.predict(pool[:n]) for n in (2, 3, 5)}
+    exp2 = {n: bst2.predict(pool[:n]) for n in (2, 3, 5)}
+    stop = threading.Event()
+    versions_seen = set()
+    reload_errors = []
+    with MicroBatcher(engine, max_batch_rows=16, max_wait_ms=1.0) as mb:
+        def worker(w):
+            i = 0
+            while not stop.is_set():
+                n = (2, 3, 5)[(w + i) % 3]
+                i += 1
+                try:
+                    served = mb.predict(pool[:n])
+                except Exception as e:                        # noqa: BLE001
+                    reload_errors.append(repr(e))
+                    return
+                if np.array_equal(served, exp1[n]):
+                    versions_seen.add(1)
+                elif np.array_equal(served, exp2[n]):
+                    versions_seen.add(2)
+                else:
+                    reload_errors.append(f"mixed-version response (n={n})")
+                    return
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        new_version = engine.reload(bst2, verify_rows=128)
+        time.sleep(0.3)
+        # corrupted candidate: inject dispatch failures through the verify
+        # path -> warmup/verification fails -> rollback, still serving v2
+        chaos.arm_failures(1000)
+        rollback_raised = False
+        try:
+            engine.reload(bst, verify_rows=64)
+        except ReloadError:
+            rollback_raised = True
+        chaos.arm_failures(0)
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=20)
+    snap = obs.snapshot()
+    reload_ok = (not reload_errors and versions_seen == {1, 2}
+                 and new_version == 2 and rollback_raised
+                 and engine.describe()["model_version"] == 2
+                 and np.array_equal(engine.predict(pool[:5]), exp2[5])
+                 and snap["counters"].get("serve.reloads") == 1
+                 and snap["counters"].get("serve.reload_rollbacks") == 1)
+    out["reload"] = {
+        "errors": reload_errors[:3], "versions_seen": sorted(versions_seen),
+        "rollback_raised": rollback_raised,
+        "model_version": engine.describe()["model_version"],
+        "reloads": snap["counters"].get("serve.reloads"),
+        "rollbacks": snap["counters"].get("serve.reload_rollbacks")}
+    if not reload_ok:
+        ok = False
+        err.append(f"reload arm failed: {out['reload']}")
+
+    # ---- arm 5: steady-state stays 0-recompile with resilience on -------
+    guard = RecompileGuard(label="serve-chaos")
+    for name, fn in engine.jit_entrypoints():
+        guard.register(fn, name)
+    try:
+        with guard:
+            guard.mark_warm()
+            for n in (1, 3, 8, 9, 64, 33):
+                engine.predict(X[:n])
+            with MicroBatcher(engine, max_batch_rows=64,
+                              max_wait_ms=1.0) as mb:
+                for n in (2, 4, 7):
+                    mb.predict(X[:n])
+    except GuardViolation as e:
+        ok = False
+        err.append(str(e)[:300])
+    rep = guard.report()
+    out["recompiles_post_warmup"] = rep["post_warmup_cache_misses"]
+    if rep["post_warmup_cache_misses"]:
+        ok = False
+        err.append(f"steady-state recompiled with resilience enabled: "
+                   f"{rep['misses_by_entrypoint']}")
+    engine.close()
+    out["health_final"] = "down"       # engine closed above, by contract
+
+    snap = obs.snapshot()
+    out["counters"] = {k: v for k, v in snap["counters"].items()
+                       if k in ("serve.shed", "serve.deadline_exceeded",
+                                "serve.breaker_trips",
+                                "serve.breaker_recoveries",
+                                "serve.host_fallback", "serve.reloads",
+                                "serve.reload_rollbacks")}
+    out["ok"] = ok
+    if err:
+        out["error"] = "; ".join(err)[:600]
+    print(json.dumps(out))
+    out_path = os.environ.get("LGBM_TPU_SERVE_CHAOS_OUT", "")
+    if out_path:
+        from lightgbm_tpu.observability.export import atomic_write_json
+        atomic_write_json(out_path, out)
+    return 0 if ok else 1
+
+
 # ------------------------------------------------------------- chaos phase
 
 def run_chaos(argv=None):
@@ -2292,6 +2605,26 @@ def run_compare(argv):
                             "problems": vp, "notes": vn, "ok": not vp}
             problems = problems + vp
             break
+        # ... and the newest banked SERVE_CHAOS result (bench.py
+        # --serve-chaos): the |serve_chaos= comparability key gates the
+        # shed-rate ceiling and p99-under-overload, so a serving-
+        # resilience regression fails here without ever being judged
+        # against fault-free serving numbers
+        for p in reversed(sorted(
+                _glob.glob(os.path.join(repo, "SERVE_CHAOS_r*.json")))):
+            pl = perf_ledger.payload_of(p)
+            if not pl or pl.get("metric") != "serve_chaos":
+                continue
+            cp, cn = perf_ledger.compare(
+                pl, entries, exclude_source=os.path.basename(p))
+            out["serve_chaos"] = {"candidate": os.path.basename(p),
+                                  "value": pl.get("value"),
+                                  "shed_rate": pl.get("shed_rate"),
+                                  "p99_ms": pl.get("p99_ms"),
+                                  "problems": cp, "notes": cn,
+                                  "ok": not cp}
+            problems = problems + cp
+            break
     out["problems"] = problems
     out["ok"] = not problems
     print(json.dumps(out))
@@ -2305,6 +2638,8 @@ if __name__ == "__main__":
         sys.exit(run_smoke())
     elif "--stream" in sys.argv:
         sys.exit(run_stream(sys.argv))
+    elif "--serve-chaos" in sys.argv:
+        sys.exit(run_serve_chaos(sys.argv))
     elif "--serve" in sys.argv:
         sys.exit(run_serve(sys.argv))
     elif "--chaos" in sys.argv:

@@ -11,6 +11,7 @@ from .ad_hoc_timing import AdHocTimingRule         # R008
 from .device_transfer import DeviceTransferRule    # R009
 from .swallowed_exceptions import SwallowedExceptionRule  # R010
 from .serving_sync import ServingSyncRule          # R011
+from .thread_leak import ThreadLeakRule            # R012
 
 _RULES = None
 
@@ -21,5 +22,6 @@ def active_rules():
         _RULES = [ControlFlowRule(), HostSyncRule(), DtypePromotionRule(),
                   PallasShapeRule(), StaticArgsRule(), ImportExecRule(),
                   SortInLoopRule(), AdHocTimingRule(), DeviceTransferRule(),
-                  SwallowedExceptionRule(), ServingSyncRule()]
+                  SwallowedExceptionRule(), ServingSyncRule(),
+                  ThreadLeakRule()]
     return _RULES

@@ -404,6 +404,23 @@ class Config:
     # AOT-compiles and pads requests into; "" = powers of two
     # 1,2,4,...,serve_max_batch_rows (padding never exceeds 2x)
     serve_buckets: str = ""
+    # --- serving resilience (serving/resilience.py, docs/Serving.md) --------
+    # admission bound: rows the micro-batcher queue may hold; a request
+    # that would overflow it is SHED with ServerOverloadedError instead of
+    # queued (0 = unbounded — the pre-resilience behavior)
+    serve_max_queue_rows: int = 32768
+    # default per-request deadline: past it a queued request is dropped at
+    # dequeue (never dispatched) and a waiting caller unblocks, both with
+    # DeadlineExceededError; 0 = no deadline. Per-call deadline_ms wins.
+    serve_deadline_ms: float = 0.0
+    # circuit breaker: this many device-dispatch failures inside
+    # serve_breaker_window_s trip the engine to `degraded` (host-predictor
+    # fallback, bit-identical answers) until the device probe succeeds;
+    # 0 disables the breaker
+    serve_breaker_failures: int = 5
+    serve_breaker_window_s: float = 30.0
+    # seconds between background device re-warm probes while degraded
+    serve_probe_interval_s: float = 1.0
 
     # --- fault tolerance (robustness/, docs/Fault-Tolerance.md) -------------
     # directory of atomic booster snapshots (ckpt_<id>.pkl); empty = off
@@ -542,6 +559,21 @@ class Config:
                           "serve_max_batch_rows=%d (the largest "
                           "rows-per-dispatch the engine compiles for)",
                           ladder[-1], self.serve_max_batch_rows)
+        if self.serve_max_queue_rows < 0:
+            Log.fatal("serve_max_queue_rows must be >= 0 (0 = unbounded), "
+                      "got %d", self.serve_max_queue_rows)
+        if self.serve_deadline_ms < 0:
+            Log.fatal("serve_deadline_ms must be >= 0 (0 = no deadline), "
+                      "got %g", self.serve_deadline_ms)
+        if self.serve_breaker_failures < 0:
+            Log.fatal("serve_breaker_failures must be >= 0 (0 = breaker "
+                      "off), got %d", self.serve_breaker_failures)
+        if self.serve_breaker_window_s <= 0:
+            Log.fatal("serve_breaker_window_s must be > 0, got %g",
+                      self.serve_breaker_window_s)
+        if self.serve_probe_interval_s <= 0:
+            Log.fatal("serve_probe_interval_s must be > 0, got %g",
+                      self.serve_probe_interval_s)
         if self.nan_policy not in ("none", "raise", "skip_iter", "clip"):
             Log.fatal("Unknown nan_policy %s (none|raise|skip_iter|clip)",
                       self.nan_policy)

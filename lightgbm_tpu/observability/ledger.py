@@ -50,6 +50,11 @@ DEFAULT_TOLERANCES = {
     # throughput, so the band is wide — a real regression (an extra
     # dispatch, a recompile in the loop) moves p99 by integer factors
     "p99": 0.75,
+    # serve-chaos shed-rate ceiling: under the SAME offered overload the
+    # shed fraction may sit this far (relative) above best-known plus a
+    # 0.05 absolute allowance — shedding much more at equal load means
+    # serving capacity regressed even if measured rows/s held
+    "shed": 0.5,
 }
 
 
@@ -83,7 +88,8 @@ def normalize_bench(payload: Optional[Dict], source: str,
                "value": None, "unit": None, "vs_baseline": None,
                "platform": None, "rows": None, "kernel": None,
                "n_devices": None, "residency": None, "tree_batch": None,
-               "auc": None, "serve": None, "p99_ms": None,
+               "auc": None, "serve": None, "serve_chaos": None,
+               "shed_rate": None, "p99_ms": None,
                "recompiles_post_warmup": None, "host_syncs": None,
                "steady_s_per_iter": None, "hbm_peak_gb": None,
                "cost": None, "error": None}
@@ -92,6 +98,7 @@ def normalize_bench(payload: Optional[Dict], source: str,
         return e
     for k in ("value", "unit", "vs_baseline", "platform", "rows", "kernel",
               "n_devices", "residency", "tree_batch", "auc", "serve",
+              "serve_chaos", "shed_rate",
               "p99_ms", "recompiles_post_warmup", "hbm_peak_gb", "error"):
         if payload.get(k) is not None:
             e[k] = payload[k]
@@ -150,6 +157,7 @@ def load_history(root: str) -> List[Dict]:
     for pat, norm in (("BENCH_r*.json", normalize_bench),
                       ("STREAM_r*.json", normalize_bench),
                       ("SERVE_r*.json", normalize_bench),
+                      ("SERVE_CHAOS_r*.json", normalize_bench),
                       ("MULTICHIP_r*.json", normalize_multichip)):
         for path in sorted(glob.glob(os.path.join(root, pat))):
             entries.append(norm(payload_of(path), os.path.basename(path),
@@ -178,11 +186,15 @@ def comparability_key(e: Dict) -> str:
     additionally key on the load shape (``serve="closed|b512xc2"``) — a
     1-row-latency arm must never be judged against a 512-row-throughput
     arm, and training benches (serve=None) never mix with serving ones.
-    Fields absent on older history are None — those entries keep comparing
-    among themselves."""
+    Serve-chaos results (``bench.py --serve-chaos``) key on their
+    fault-injection shape (``serve_chaos="open|b4|overload"``): numbers
+    measured UNDER injected overload and faults are a comparability class
+    of their own. Fields absent on older history are None — those entries
+    keep comparing among themselves."""
     return (f"platform={e.get('platform')}|rows={e.get('rows')}"
             f"|kernel={e.get('kernel')}|n_devices={e.get('n_devices')}"
-            f"|residency={e.get('residency')}|serve={e.get('serve')}")
+            f"|residency={e.get('residency')}|serve={e.get('serve')}"
+            f"|serve_chaos={e.get('serve_chaos')}")
 
 
 def multichip_key(e: Dict) -> str:
@@ -233,7 +245,7 @@ def best_known(entries: List[Dict],
                  and e.get("source") != exclude_source
                  and comparability_key(e) == key]
         for field in ("recompiles_post_warmup", "host_syncs", "hbm_peak_gb",
-                      "p99_ms"):
+                      "p99_ms", "shed_rate"):
             vals = [e[field] for e in group if e.get(field) is not None]
             slot[f"min_{field}"] = min(vals) if vals else None
     return best
@@ -249,7 +261,8 @@ def build_ledger(root: str) -> Dict:
                     v.get("min_recompiles_post_warmup"),
                 "min_host_syncs": v.get("min_host_syncs"),
                 "min_hbm_peak_gb": v.get("min_hbm_peak_gb"),
-                "min_p99_ms": v.get("min_p99_ms")}
+                "min_p99_ms": v.get("min_p99_ms"),
+                "min_shed_rate": v.get("min_shed_rate")}
             for k, v in sorted(best_known(entries).items())}
     best_mc = {k: {"source": v["source"], "round": v["round"],
                    "value": v["value"],
@@ -349,6 +362,14 @@ def compare(candidate: Dict, entries: List[Dict],
             problems.append(
                 f"p99 latency regression: {c['p99_ms']} ms vs best-known "
                 f"{min_p99} ms (+{tol['p99']:.0%} band)")
+        min_shed = slot.get("min_shed_rate")
+        if (min_shed is not None and c.get("shed_rate") is not None
+                and c["shed_rate"] > min_shed * (1.0 + tol["shed"]) + 0.05):
+            problems.append(
+                f"shed-rate regression: {c['shed_rate']} of offered load "
+                f"shed vs best-known {min_shed} — shedding more at the "
+                f"same offered overload means serving capacity regressed "
+                f"(+{tol['shed']:.0%} relative +0.05 absolute band)")
         problems.extend(_cost_drift(c, b, tol["cost"]))
     return problems, notes
 

@@ -104,10 +104,13 @@ VOLATILE_CONFIG_FIELDS = frozenset({
     "tpu_time_tag", "tpu_profile_dir", "tpu_profile_iters", "telemetry_dir",
     # cost/memory introspection (observability/costs.py, snapshot dumps)
     "tpu_cost_analysis", "dump_snapshot",
-    # serving knobs (lightgbm_tpu/serving): bucket ladder and batcher
-    # policy shape INFERENCE dispatch only — a checkpoint trained under any
-    # of them resumes under any other
+    # serving knobs (lightgbm_tpu/serving): bucket ladder, batcher policy,
+    # and the resilience knobs (admission bound, deadlines, circuit
+    # breaker, probe cadence) shape INFERENCE dispatch only — a checkpoint
+    # trained under any of them resumes under any other
     "serve_max_batch_rows", "serve_max_wait_ms", "serve_buckets",
+    "serve_max_queue_rows", "serve_deadline_ms", "serve_breaker_failures",
+    "serve_breaker_window_s", "serve_probe_interval_s",
 })
 
 

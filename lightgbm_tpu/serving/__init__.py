@@ -4,19 +4,34 @@
   format (protobuf / text / JSON / in-memory Booster), stack it once,
   AOT-compile the rank-encoded forest walk per batch-size bucket, and
   dispatch padded requests with zero steady-state recompiles. Served
-  predictions are bit-identical to ``Booster.predict``.
+  predictions are bit-identical to ``Booster.predict``. Resilience:
+  circuit-breaker degradation to the host predictor with a background
+  device re-warm probe, ``health()`` (``ready|degraded|down``), and hot
+  ``reload()`` with bit-identity verification and rollback.
 - ``MicroBatcher`` (batcher.py)  — thread-safe coalescing of concurrent
   small ``predict()`` calls into one device dispatch under a max-wait
-  deadline, with per-request de-interleaving of results.
+  deadline, with per-request de-interleaving of results, bounded-queue
+  admission control (``ServerOverloadedError`` load shedding),
+  per-request deadlines (``DeadlineExceededError``), and typed shutdown
+  (``ServingClosedError``).
+- resilience primitives (resilience.py) — the typed error family,
+  ``CircuitBreaker``, and the ``DispatchChaos`` fault injector driven by
+  ``bench.py --serve-chaos``.
 - load generators (loadgen.py)   — closed-loop and open-loop (Poisson)
-  drivers + latency stats, shared by ``bench.py --serve`` and the CLI's
-  ``task=serve_bench``.
+  drivers + latency stats, shared by ``bench.py --serve`` /
+  ``--serve-chaos`` and the CLI's ``task=serve_bench``.
 
 Every request feeds the process-wide metrics registry: ``serve.requests``
-/ ``serve.rows`` counters, ``serve.queue_depth`` gauges,
-``serve.batch_fill_frac`` histogram, and the ``serve.latency_ms`` /
+/ ``serve.rows`` counters, ``serve.queue_depth`` / ``serve.queue_rows``
+gauges, ``serve.batch_fill_frac`` histogram, the ``serve.latency_ms`` /
 ``serve.dispatch_ms`` quantile summaries whose p50/p99 surface in
-``observability.snapshot()`` — the live serving probe.
+``observability.snapshot()`` — and the resilience series
+(``serve.shed``, ``serve.deadline_exceeded``, ``serve.breaker_trips``,
+``serve.reloads``, ``serve.health``, ``serve.model_version``).
 """
 from .batcher import MicroBatcher                                # noqa: F401
 from .engine import ServingEngine, bucket_ladder                 # noqa: F401
+from .resilience import (CircuitBreaker, DeadlineExceededError,  # noqa: F401
+                         DeviceDispatchError, DispatchChaos, ReloadError,
+                         ServerOverloadedError, ServingClosedError,
+                         ServingError)

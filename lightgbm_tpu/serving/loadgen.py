@@ -58,11 +58,16 @@ def _request_slices(X: np.ndarray, batch_rows: int):
 
 
 def run_closed_loop(predict: Callable, X: np.ndarray, batch_rows: int,
-                    concurrency: int, requests_per_worker: int) -> Dict:
+                    concurrency: int, requests_per_worker: int,
+                    stop_on_error: bool = True) -> Dict:
     """``concurrency`` workers, back-to-back requests of ``batch_rows``
-    rows each; returns latencies + aggregate rows/s."""
+    rows each; returns latencies + aggregate rows/s.
+    ``stop_on_error=False`` records the error and keeps the worker going —
+    the chaos-harness mode, where typed per-request errors (sheds,
+    deadline misses) are the measurement, not a failure."""
     lats: List[List[float]] = [[] for _ in range(concurrency)]
     errors: List[str] = []
+    err_lock = threading.Lock()
     start_gate = threading.Barrier(concurrency + 1)
 
     def worker(w: int):
@@ -74,8 +79,11 @@ def run_closed_loop(predict: Callable, X: np.ndarray, batch_rows: int,
             try:
                 predict(Xr)
             except Exception as e:                            # noqa: BLE001
-                errors.append(repr(e))
-                return
+                with err_lock:
+                    errors.append(repr(e))
+                if stop_on_error:
+                    return
+                continue
             lats[w].append((obs.clock() - t0) * 1e3)
 
     threads = [threading.Thread(target=worker, args=(w,), daemon=True)
@@ -104,11 +112,15 @@ def run_closed_loop(predict: Callable, X: np.ndarray, batch_rows: int,
 
 def run_open_loop(predict: Callable, X: np.ndarray, batch_rows: int,
                   rate_rps: float, duration_s: float, seed: int = 0,
-                  workers: Optional[int] = None) -> Dict:
+                  workers: Optional[int] = None,
+                  stop_on_error: bool = True) -> Dict:
     """Poisson arrivals at ``rate_rps`` for ``duration_s`` seconds; a
     worker pool large enough to not throttle arrivals issues the requests.
     Latency includes any queue delay (open-loop semantics). The arrival
-    schedule is a seeded RNG — reruns replay the same offered load."""
+    schedule is a seeded RNG — reruns replay the same offered load.
+    ``stop_on_error=False`` keeps the worker issuing after a per-request
+    error (recorded in ``errors``) — the overload-chaos mode, where sheds
+    and deadline misses are expected outcomes of the offered load."""
     import time as _time   # sleep only; wall-clock stays observability.clock
 
     rng = np.random.RandomState(seed)
@@ -145,8 +157,11 @@ def run_open_loop(predict: Callable, X: np.ndarray, batch_rows: int,
             try:
                 predict(Xr)
             except Exception as e:                            # noqa: BLE001
-                errors.append(repr(e))
-                return
+                with lat_lock:
+                    errors.append(repr(e))
+                if stop_on_error:
+                    return
+                continue
             with lat_lock:
                 lats.append((obs.clock() - t_sched) * 1e3)
 

@@ -1,0 +1,17 @@
+"""Fixture: worker threads with no leak-proof lifecycle (R012)."""
+import threading
+from threading import Thread
+
+
+class LeakyWorkerPool:
+    def __init__(self, work):
+        # R012: not daemon, and close() below never joins it
+        self._worker = threading.Thread(target=work, name="leaky-worker")
+        self._worker.start()
+
+    def close(self):
+        pass                       # forgot self._worker.join()
+
+
+def fire_and_forget(fn):
+    Thread(target=fn).start()      # R012: unassigned, not daemon, no join

@@ -25,6 +25,7 @@ def _train(n=3000, f=8, trees=20, missing=False, seed=0):
     return bst, X
 
 
+@pytest.mark.slow
 def test_device_forest_matches_host_exactly():
     bst, X = _train()
     host = np.zeros(X.shape[0])
@@ -40,6 +41,7 @@ def test_device_forest_matches_host_exactly():
         np.testing.assert_allclose(one, t.leaf_value[leaves_host], rtol=1e-7)
 
 
+@pytest.mark.slow
 def test_device_forest_missing_values():
     bst, X = _train(missing=True, seed=3)
     host = np.zeros(X.shape[0])
@@ -104,6 +106,7 @@ def test_device_forest_root_is_leaf_only():
     np.testing.assert_allclose(out, np.full(7, 3.0), rtol=1e-7)
 
 
+@pytest.mark.slow
 def test_device_forest_large_batch():
     """Correctness at the 1M-row-tree routing scale (absolute wall-clock is
     a bench concern — the VERDICT target of 1M x 28 x 100 trees < 2s is

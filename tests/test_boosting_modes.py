@@ -8,6 +8,7 @@ from sklearn.metrics import log_loss, mean_squared_error, roc_auc_score
 import lightgbm_tpu as lgb
 
 
+@pytest.mark.slow
 def test_dart():
     X, y = load_breast_cancer(return_X_y=True)
     params = {"objective": "binary", "boosting_type": "dart", "verbose": -1,
@@ -18,6 +19,7 @@ def test_dart():
     assert ll < 0.3
 
 
+@pytest.mark.slow
 def test_dart_xgboost_mode():
     X, y = make_regression(n_samples=600, n_features=8, noise=5.0, random_state=1)
     params = {"objective": "regression", "boosting_type": "dart", "verbose": -1,
@@ -27,6 +29,7 @@ def test_dart_xgboost_mode():
     assert mean_squared_error(y, bst.predict(X)) < 0.6 * np.var(y)
 
 
+@pytest.mark.slow
 def test_goss():
     X, y = load_breast_cancer(return_X_y=True)
     params = {"objective": "binary", "boosting_type": "goss", "verbose": -1,
@@ -49,6 +52,7 @@ def test_rf():
     assert roc_auc_score(y, pred) > 0.98
 
 
+@pytest.mark.slow
 def test_custom_objective_fobj():
     X, y = make_regression(n_samples=500, n_features=6, noise=3.0, random_state=2)
 

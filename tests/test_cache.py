@@ -100,6 +100,7 @@ def test_stacked_forest_cache_alternating_slices():
     assert bst._stacked_forests(bst.trees, 1) is not f_full
 
 
+@pytest.mark.slow
 def test_checkpoint_rollback_resume_bit_identical(tmp_path):
     """checkpoint -> train 2 more iters -> rollback -> resume -> retrain:
     the rev-keyed LRU must never serve a pre-rollback/pre-resume forest

@@ -15,6 +15,7 @@ def _cat_data(n=3000, n_cats=12, seed=0):
     return np.column_stack([cat.astype(float), x1]), y
 
 
+@pytest.mark.slow
 def test_categorical_sorted_mode_quality():
     X, y = _cat_data()
     params = dict(objective="regression", num_leaves=15, min_data_in_leaf=5,
@@ -75,6 +76,7 @@ def test_categorical_unseen_category_goes_right():
     assert np.isfinite(p).all()
 
 
+@pytest.mark.slow
 def test_categorical_parallel_strategies_agree():
     X, y = _cat_data()
     preds = {}
@@ -88,6 +90,7 @@ def test_categorical_parallel_strategies_agree():
     np.testing.assert_allclose(preds["serial"], preds["data"], rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_categorical_via_params_categorical_column():
     X, y = _cat_data()
     params = dict(objective="regression", num_leaves=15, min_data_in_leaf=5,

@@ -37,7 +37,8 @@ BASE = dict(objective="regression", num_leaves=15, learning_rate=0.1,
 
 # ------------------------------------------------------------ kill-and-resume
 
-@pytest.mark.parametrize("tree_learner", ["serial", "data"])
+@pytest.mark.parametrize("tree_learner", [
+    "serial", pytest.param("data", marks=pytest.mark.slow)])
 def test_kill_and_resume_bit_identical(tmp_path, tree_learner):
     """Training killed between checkpoints, restarted with the identical
     command (resume_from=auto), must produce bit-identical model text to an
@@ -298,8 +299,13 @@ def _corrupt_file(path, how, seed=5):
 
 # ------------------------------------------- corrupt-latest-then-resume
 
-@pytest.mark.parametrize("mode", sorted(MODES))
-@pytest.mark.parametrize("how", ["bitflip", "truncate"])
+# tier-1 keeps the serial bitflip arm; the other residency/parallelism x
+# corruption combinations are tier-2 (`slow`, still in `make check`)
+@pytest.mark.parametrize("how,mode", [
+    ("bitflip", "serial")] + [
+    pytest.param(h, m, marks=pytest.mark.slow)
+    for h in ("bitflip", "truncate") for m in sorted(MODES)
+    if (h, m) != ("bitflip", "serial")])
 def test_corrupt_latest_lineage_recovery(tmp_path, mode, how):
     """resume_from=auto walks back past a corrupt latest snapshot to the
     newest one that verifies, and the continued run is bit-identical to

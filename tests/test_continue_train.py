@@ -2,6 +2,7 @@
 (reference: test_engine.py:360-411 continued training from file/string/model;
 gbdt.cpp:475 RollbackOneIter; callback.py reset_parameter)."""
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
 
@@ -17,6 +18,7 @@ PARAMS = dict(objective="regression", num_leaves=15, min_data_in_leaf=5,
               device="cpu", verbose=-1)
 
 
+@pytest.mark.slow
 def test_continue_from_booster():
     X, y = _data()
     ds = lgb.Dataset(X, label=y)
@@ -42,6 +44,7 @@ def test_continue_from_file(tmp_path):
                                bst1.predict(X), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_continue_equivalent_to_straight_run_quality():
     X, y = _data()
     bst_one = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=20)

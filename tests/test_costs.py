@@ -311,7 +311,7 @@ def test_ledger_builds_from_checked_in_history():
     assert len(entries) >= 10
     doc = ledger.build_ledger(REPO)
     key = ("platform=tpu|rows=10500000|kernel=xla|n_devices=None"
-           "|residency=None|serve=None")
+           "|residency=None|serve=None|serve_chaos=None")
     assert doc["best"][key]["value"] == 6.0
     assert doc["best"][key]["source"] == "BENCH_r05.json"
     # the committed ledger matches the history (no drift) — the same
@@ -394,6 +394,7 @@ def test_quick_prebank_not_judged_against_headline():
     assert any("no comparable history" in n for n in notes)
 
 
+@pytest.mark.slow
 def test_bench_compare_cli_exit_codes(tmp_path):
     bad = tmp_path / "regressed.json"
     bad.write_text(json.dumps(

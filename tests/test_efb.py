@@ -71,6 +71,7 @@ def test_unpack_map_consistency():
                 assert ub - plan.off[f] == b
 
 
+@pytest.mark.slow
 def test_bundled_training_matches_unbundled():
     X, y, _, _ = _mixed_sparse_data()
     params = dict(objective="binary", num_leaves=15, min_data_in_leaf=5,

@@ -14,6 +14,7 @@ def _split(X, y, seed=42):
     return train_test_split(X, y, test_size=0.1, random_state=seed)
 
 
+@pytest.mark.slow
 def test_binary():
     X, y = load_breast_cancer(return_X_y=True)
     X_train, X_test, y_train, y_test = _split(X, y)
@@ -31,6 +32,7 @@ def test_binary():
     assert evals_result["valid_0"]["binary_logloss"][-1] == pytest.approx(ll, abs=1e-3)
 
 
+@pytest.mark.slow
 def test_regression():
     X, y = make_regression(n_samples=2000, n_features=20, n_informative=10,
                            noise=10.0, random_state=7)
@@ -47,6 +49,7 @@ def test_regression():
     assert evals_result["valid_0"]["l2"][-1] == pytest.approx(mse, rel=1e-3)
 
 
+@pytest.mark.slow
 def test_binary_auc():
     X, y = load_breast_cancer(return_X_y=True)
     X_train, X_test, y_train, y_test = _split(X, y)
@@ -57,6 +60,7 @@ def test_binary_auc():
     assert auc > 0.98
 
 
+@pytest.mark.slow
 def test_multiclass():
     X, y = load_digits(n_class=10, return_X_y=True)
     X_train, X_test, y_train, y_test = _split(X, y)
@@ -123,6 +127,7 @@ def test_missing_value_zero_as_missing():
     assert bst.predict(np.array([[-1.5]]))[0] < 0.3
 
 
+@pytest.mark.slow
 def test_early_stopping():
     X, y = load_breast_cancer(return_X_y=True)
     X_train, X_test, y_train, y_test = _split(X, y)
@@ -135,6 +140,7 @@ def test_early_stopping():
     assert bst.current_iteration() < 300
 
 
+@pytest.mark.slow
 def test_weighted_training():
     X, y = load_breast_cancer(return_X_y=True)
     w = np.where(y > 0, 2.0, 1.0)
@@ -145,6 +151,7 @@ def test_weighted_training():
     assert log_loss(y, pred) < 0.2
 
 
+@pytest.mark.slow
 def test_bagging_and_feature_fraction():
     X, y = load_breast_cancer(return_X_y=True)
     X_train, X_test, y_train, y_test = _split(X, y)
@@ -169,6 +176,7 @@ def test_exact_leafwise_mode():
         assert t.num_leaves <= 15
 
 
+@pytest.mark.slow
 def test_lambda_l1_l2():
     X, y = make_regression(n_samples=800, n_features=10, noise=5.0, random_state=5)
     for l1, l2 in [(0.0, 10.0), (5.0, 0.0), (2.0, 2.0)]:
@@ -180,6 +188,7 @@ def test_lambda_l1_l2():
         assert mse < 0.5 * np.var(y)
 
 
+@pytest.mark.slow
 def test_objectives_run():
     """Every non-rank objective trains and improves on its default metric."""
     rng = np.random.default_rng(9)

@@ -13,6 +13,7 @@ GUIDE = os.path.join(os.path.dirname(HERE), "examples", "python-guide")
 
 @pytest.mark.parametrize("script", sorted(
     os.path.basename(p) for p in glob.glob(os.path.join(GUIDE, "*.py"))))
+@pytest.mark.slow
 def test_example_runs(script):
     with open(os.path.join(GUIDE, script)) as fh:
         src = fh.read()

@@ -9,6 +9,7 @@ import pytest
 import lightgbm_tpu as lgb
 
 
+@pytest.mark.slow
 def test_random_config_sweep():
     rng = np.random.RandomState(77)
     for trial in range(10):
@@ -62,6 +63,7 @@ def test_random_config_sweep():
                                        rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_lifecycle_sweep():
     """Boosting lifecycle invariants (CI slice of the round-5 3x25-trial
     sweep): continuation tree counts, truncated predict == stage-1 model,

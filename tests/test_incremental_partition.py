@@ -103,6 +103,7 @@ def test_wave_loop_jaxpr_sort_presence(incremental, expect_sort):
 
 # ------------------------------------------------------- bit-identity pins
 
+@pytest.mark.slow
 @pytest.mark.parametrize("tree_learner", ["serial", "data"])
 def test_incremental_vs_legacy_bit_identical(tree_learner):
     X, y = _make_binary()
@@ -111,6 +112,7 @@ def test_incremental_vs_legacy_bit_identical(tree_learner):
     _assert_identical(b_inc, b_leg, X)
 
 
+@pytest.mark.slow
 def test_incremental_vs_legacy_u4_code_mode():
     """max_bin=15 engages the u4 nibble-packed row layout — the compacted
     gather's unpack must see the identical byte stream through the
@@ -121,6 +123,7 @@ def test_incremental_vs_legacy_u4_code_mode():
     _assert_identical(b_inc, b_leg, X)
 
 
+@pytest.mark.slow
 def test_incremental_vs_legacy_exact_leafwise():
     """tpu_wave_size=1 (the reference's one-leaf-at-a-time ordering) takes
     maximally many waves — the partition survives the longest carry chains."""
@@ -131,6 +134,7 @@ def test_incremental_vs_legacy_exact_leafwise():
 
 
 @pytest.mark.parametrize("tree_learner", ["serial", "data"])
+@pytest.mark.slow
 def test_incremental_tree_batch_bit_identical(tree_learner):
     """tree_batch>1 fuses whole iterations under lax.scan — the per-tree
     partition reset (identity permutation at tree start) must hold inside
@@ -149,6 +153,7 @@ def test_incremental_tree_batch_bit_identical(tree_learner):
     np.testing.assert_array_equal(b_inc.predict(X), b_inc1.predict(X))
 
 
+@pytest.mark.slow
 def test_incremental_checkpoint_resume_mid_tree_batch(tmp_path):
     """Interrupt a batched incremental run at a batch boundary, resume it,
     and land bit-identical to BOTH the uninterrupted incremental run and
@@ -169,6 +174,7 @@ def test_incremental_checkpoint_resume_mid_tree_batch(tmp_path):
     np.testing.assert_array_equal(full.predict(X), legacy.predict(X))
 
 
+@pytest.mark.slow
 def test_incremental_mixed_kernel_interpret(monkeypatch):
     """The mixed dispatch routes COMPACTED passes through the Pallas kernel
     — its chunk gather must read the carried permutation through the same

@@ -118,6 +118,7 @@ def test_binary_autodetect_roundtrip(tmp_path):
     assert acc > 0.85
 
 
+@pytest.mark.slow
 def test_chunked_load_speed(tmp_path):
     """0.5M x 10 CSV parses via the chunked C reader in seconds, not minutes
     (the round-1 per-line Python parser took minutes at this scale)."""

@@ -40,6 +40,7 @@ BASE = dict(objective="regression", num_leaves=15, learning_rate=0.1,
 
 # ---------------------------------------------------- serial parity (fused)
 
+@pytest.mark.slow
 def test_data_parallel_fused_batch_matches_serial():
     """The acceptance gate: 8-device data-parallel training through the
     FUSED tree_batch scan stays within the established serial parity gap
@@ -55,6 +56,7 @@ def test_data_parallel_fused_batch_matches_serial():
     np.testing.assert_allclose(p_serial, p_fused, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_data_parallel_fused_bitexact_vs_per_tree():
     """tree_batch=4 under the 8-device mesh is BIT-identical to the same
     sharded training dispatched per tree — the fused scan carries the
@@ -69,6 +71,7 @@ def test_data_parallel_fused_bitexact_vs_per_tree():
 
 
 @pytest.mark.parametrize("strategy", ["feature", "voting"])
+@pytest.mark.slow
 def test_fused_batch_smoke_other_strategies(strategy):
     """feature/voting train through the fused scan on the same harness and
     produce finite, useful models."""

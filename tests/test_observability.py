@@ -339,6 +339,7 @@ def test_train_emits_nested_spans_and_counters(telemetry):
     assert counters["counters"]["trees.trained"] == 6
 
 
+@pytest.mark.slow
 def test_eval_and_checkpoint_spans(telemetry, tmp_path):
     X, y = _data()
     params = dict(PARAMS, metric="binary_logloss",

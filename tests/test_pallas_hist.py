@@ -91,6 +91,7 @@ def test_slot_grouped_position_slots_match():
                                rtol=1e-5, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_train_with_pallas_kernel_matches_xla():
     """End-to-end: tpu_hist_kernel=pallas grows the same trees as xla."""
     import lightgbm_tpu as lgb

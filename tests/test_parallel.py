@@ -53,6 +53,7 @@ def test_mesh_context_devices():
     assert make_parallel_context(cfg).mesh is None
 
 
+@pytest.mark.slow
 def test_feature_parallel_bitexact():
     X, y = _make_regression()
     _, p_serial = _train_predict(X, y, "serial")
@@ -60,6 +61,7 @@ def test_feature_parallel_bitexact():
     np.testing.assert_array_equal(p_serial, p_feat)
 
 
+@pytest.mark.slow
 def test_data_parallel_close_to_serial():
     X, y = _make_regression()
     _, p_serial = _train_predict(X, y, "serial")
@@ -67,6 +69,7 @@ def test_data_parallel_close_to_serial():
     np.testing.assert_allclose(p_serial, p_data, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_voting_parallel_quality():
     X, y = _make_regression()
     _, p_serial = _train_predict(X, y, "serial")
@@ -84,6 +87,7 @@ def test_data_parallel_binary_auc():
     assert acc > 0.85
 
 
+@pytest.mark.slow
 def test_data_parallel_multiclass():
     rng = np.random.RandomState(3)
     X = rng.randn(1500, 8)
@@ -96,6 +100,7 @@ def test_data_parallel_multiclass():
     assert np.mean(np.argmax(p, axis=1) == y) > 0.8
 
 
+@pytest.mark.slow
 def test_data_parallel_with_bagging_and_feature_fraction():
     X, y = _make_regression(n=4000, f=16)
     bst, p = _train_predict(X, y, "data", bagging_fraction=0.7, bagging_freq=1,
@@ -103,6 +108,7 @@ def test_data_parallel_with_bagging_and_feature_fraction():
     assert np.mean((p - y) ** 2) < np.var(y) * 0.3
 
 
+@pytest.mark.slow
 def test_feature_parallel_odd_feature_count():
     # F=13 not divisible by 8 devices -> padded feature blocks
     X, y = _make_regression(f=13)
@@ -124,6 +130,7 @@ def _make_sparse_exclusive(n=3000, f=24, seed=5):
 
 
 @pytest.mark.parametrize("strategy", ["data", "voting", "feature"])
+@pytest.mark.slow
 def test_distributed_efb(strategy):
     """EFB must engage under EVERY distributed strategy (EFB precedes
     learner choice in the reference, dataset.cpp:66-210) and match the

@@ -52,7 +52,9 @@ def _train(objective="binary", n=3000, f=8, trees=20, missing=None,
 
 # ------------------------------------------------------- interchange identity
 
-@pytest.mark.parametrize("objective", ["regression", "binary", "multiclass"])
+@pytest.mark.parametrize("objective", [
+    pytest.param("regression", marks=pytest.mark.slow), "binary",
+    pytest.param("multiclass", marks=pytest.mark.slow)])
 def test_proto_roundtrip_bit_identical(tmp_path, objective):
     """protobuf -> ServingEngine serves BIT-identically to the training
     booster's in-memory predict() (the acceptance pin)."""
@@ -67,6 +69,7 @@ def test_proto_roundtrip_bit_identical(tmp_path, objective):
                           eng.predict(probe, raw_score=True))
 
 
+@pytest.mark.slow
 def test_text_and_json_roundtrip_bit_identical(tmp_path):
     bst, X = _train("binary")
     p_txt = str(tmp_path / "m.txt")
@@ -206,11 +209,17 @@ def test_loadgen_rows_count_capped_at_pool():
     """rows/s counts rows actually served: when batch_rows exceeds the
     pool, _request_slices serves the whole pool per request and the
     throughput math must not credit the requested batch size."""
+    import time
+
     from lightgbm_tpu.serving.loadgen import run_closed_loop, run_open_loop
     X = np.zeros((10, 3))
     served = []
-    r = run_closed_loop(lambda Xr: served.append(Xr.shape[0]), X,
-                        batch_rows=512, concurrency=2,
+
+    def _serve(Xr):
+        served.append(Xr.shape[0])
+        time.sleep(0.002)   # keep wall >> the 1e-4 s wall_s rounding step
+
+    r = run_closed_loop(_serve, X, batch_rows=512, concurrency=2,
                         requests_per_worker=3)
     assert set(served) == {10} and r["batch_rows_effective"] == 10
     assert r["rows_per_s"] <= 1.05 * 10 * r["requests"] / r["wall_s"]
@@ -271,6 +280,7 @@ def _forest_for_encode(trees=25, f=10, seed=1):
     return StackedForest(bst.trees, bst.num_total_features)
 
 
+@pytest.mark.slow
 def test_encode_rows_vectorized_matches_loop():
     """The one-searchsorted concatenated-grid encode is bit-identical to
     the per-feature loop: ties, NaN, zero-range, ±inf, -0.0, empty grids
@@ -294,6 +304,7 @@ def test_encode_rows_vectorized_matches_loop():
     np.testing.assert_array_equal(vec, loop)
 
 
+@pytest.mark.slow
 def test_encode_rows_selects_by_size_and_agrees():
     forest = _forest_for_encode()
     rng = np.random.RandomState(8)
@@ -307,7 +318,10 @@ def test_encode_rows_selects_by_size_and_agrees():
 
 # ------------------------------------------------- device-vs-host parity suite
 
-@pytest.mark.parametrize("missing", [None, "zero", "nan", "both"])
+@pytest.mark.parametrize("missing", [
+    pytest.param(None, marks=pytest.mark.slow),
+    pytest.param("zero", marks=pytest.mark.slow),
+    pytest.param("nan", marks=pytest.mark.slow), "both"])
 def test_device_predict_parity_missing_types(missing):
     """Device walk === host predictor across missing-value regimes
     (satellite 3); zero_as_missing exercises missing_type=zero nodes."""
@@ -460,7 +474,7 @@ def test_ledger_serve_key_and_p99_gate():
     e = ledger.normalize_bench(serve, "SERVE_r01.json", 1)
     assert e["serve"] == "closed|b512xc2" and e["p99_ms"] == 40.0
     key = ledger.comparability_key(e)
-    assert key.endswith("|serve=closed|b512xc2")
+    assert "|serve=closed|b512xc2|" in key
     train_e = ledger.normalize_bench(
         {"metric": "bench", "value": 6.0, "platform": "cpu",
          "rows": 20000, "kernel": "xla", "n_devices": 1}, "BENCH_rX.json", 9)

@@ -1,0 +1,576 @@
+"""Serving resilience (docs/Serving.md "Resilience"): admission control /
+load shedding, per-request deadlines at admission and dequeue, typed
+shutdown semantics, circuit-breaker degradation with probe recovery, and
+hot model reload with bit-identity verification and rollback — including
+reload under concurrent load (every response matches exactly ONE model
+version)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import observability as obs
+from lightgbm_tpu.serving import (CircuitBreaker, DeadlineExceededError,
+                                  DispatchChaos, MicroBatcher, ReloadError,
+                                  ServerOverloadedError, ServingClosedError,
+                                  ServingEngine, ServingError)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs.reset_for_tests()
+    yield
+    obs.reset_for_tests()
+
+
+def _train(trees=10, seed=0, n=1500, f=8, **extra):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f) * 4 - 2
+    y = (X[:, 0] + X[:, 1] ** 2 >
+         np.median(X[:, 0] + X[:, 1] ** 2)).astype(np.float64)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 10, "seed": seed, **extra}
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=trees), X
+
+
+def _engine(bst, **params):
+    base = {"serve_buckets": "4,32", "verbose": -1,
+            "serve_breaker_failures": 3, "serve_breaker_window_s": 30.0,
+            "serve_probe_interval_s": 0.05}
+    base.update(params)
+    return ServingEngine(bst, params=base)
+
+
+def _wait_for(cond, timeout=10.0, interval=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# --------------------------------------------------------- admission control
+
+def test_queue_full_sheds_with_typed_error_and_never_queues():
+    """A request that would overflow serve_max_queue_rows is REFUSED with
+    ServerOverloadedError before it is queued; admitted requests still
+    complete bit-identically once the hung dispatch clears."""
+    bst, X = _train()
+    eng = _engine(bst)
+    chaos = DispatchChaos()
+    eng.chaos = chaos
+    chaos.arm_hang(1.0, n=1)             # wedge the worker's first dispatch
+    results, errors = {}, {}
+
+    with MicroBatcher(eng, max_batch_rows=4, max_wait_ms=1.0,
+                      max_queue_rows=4) as mb:
+        def call(i, lo, n):
+            try:
+                results[i] = mb.predict(X[lo:lo + n])
+            except ServingError as e:
+                errors[i] = e
+
+        threads = []
+        # t0 dequeues immediately and hangs on dispatch; t1+t2 fill the
+        # 4-row queue bound; t3 must shed
+        for i, n in enumerate((2, 2, 2, 1)):
+            t = threading.Thread(target=call, args=(i, 10 * i, n),
+                                 daemon=True)
+            threads.append(t)
+            t.start()
+            time.sleep(0.15)
+        for t in threads:
+            t.join(timeout=15)
+    assert isinstance(errors.get(3), ServerOverloadedError), \
+        (errors, list(results))
+    for i in (0, 1, 2):
+        assert i in results, (i, errors)
+        np.testing.assert_array_equal(results[i],
+                                      eng.predict(X[10 * i:10 * i + 2]))
+    snap = obs.snapshot()
+    assert snap["counters"]["serve.shed"] == 1
+    assert snap["gauges"]["serve.queue_rows"] == 0
+    eng.close()
+
+
+def test_oversized_request_admits_onto_empty_queue():
+    """A request larger than the whole queue bound still admits when the
+    queue is empty (the engine chunks it) — otherwise it could never be
+    served at all."""
+    bst, X = _train()
+    eng = _engine(bst)
+    with MicroBatcher(eng, max_batch_rows=64, max_wait_ms=1.0,
+                      max_queue_rows=8) as mb:
+        out = mb.predict(X[:50])             # 50 rows > bound of 8
+        np.testing.assert_array_equal(out, eng.predict(X[:50]))
+    eng.close()
+
+
+# ----------------------------------------------------------------- deadlines
+
+def test_expired_requests_dropped_at_dequeue_without_dispatch():
+    """Requests whose deadline passed while queued behind a hung dispatch
+    are failed at dequeue WITHOUT spending a device dispatch; callers'
+    waits are bounded by their own deadline."""
+    bst, X = _train()
+    eng = _engine(bst)
+    chaos = DispatchChaos()
+    eng.chaos = chaos
+    chaos.arm_hang(1.2, n=1)
+    outcomes = {}
+
+    with MicroBatcher(eng, max_batch_rows=4, max_wait_ms=1.0,
+                      deadline_ms=200.0) as mb:
+        def call(i):
+            t0 = time.monotonic()
+            try:
+                mb.predict(X[:2])
+                outcomes[i] = ("ok", time.monotonic() - t0)
+            except DeadlineExceededError:
+                outcomes[i] = ("deadline", time.monotonic() - t0)
+
+        threads = [threading.Thread(target=call, args=(i,), daemon=True)
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+            time.sleep(0.1)
+        for t in threads:
+            t.join(timeout=15)
+        dispatches_during_hang = chaos.dispatches
+        # all three callers unblocked at ~their deadline, far before the
+        # 1.2 s hang cleared
+        for i, (kind, dt) in outcomes.items():
+            assert kind == "deadline", outcomes
+            assert dt < 1.0, outcomes
+        # only the FIRST request cost a dispatch; the two expired behind
+        # it were dropped at dequeue
+        assert dispatches_during_hang == 1, chaos.dispatches
+        # after the hang clears the batcher serves again, bit-identically
+        out = mb.predict(X[:3], deadline_ms=0)   # explicit 0 = no deadline
+        np.testing.assert_array_equal(out, eng.predict(X[:3]))
+    assert obs.snapshot()["counters"]["serve.deadline_exceeded"] >= 3
+    eng.close()
+
+
+def test_engine_predict_deadline_between_chunks():
+    """The direct engine path checks the deadline between chunk
+    dispatches — a slow device raises DeadlineExceededError instead of
+    burning the remaining chunks."""
+    bst, X = _train()
+    eng = _engine(bst, serve_buckets="4")
+    chaos = DispatchChaos()
+    chaos.slowdown_s = 0.1
+    eng.chaos = chaos
+    with pytest.raises(DeadlineExceededError):
+        eng.predict(X[:16], deadline_ms=50.0)    # 4 chunks x 100 ms each
+    assert chaos.dispatches < 4
+    chaos.slowdown_s = 0.0
+    np.testing.assert_array_equal(eng.predict(X[:16]), bst.predict(X[:16]))
+    eng.close()
+
+
+def test_default_deadline_from_config():
+    """serve_deadline_ms is the default when no per-call override rides in
+    (checked between chunk dispatches on the direct path)."""
+    bst, X = _train()
+    eng = _engine(bst, serve_deadline_ms=40.0, serve_buckets="4")
+    chaos = DispatchChaos()
+    eng.chaos = chaos
+    chaos.arm_hang(0.5, n=1)             # first of two chunks hangs
+    with pytest.raises(DeadlineExceededError):
+        eng.predict(X[:8])
+    eng.close()
+
+
+# ---------------------------------------------------------- typed shutdown
+
+def test_predict_after_close_raises_immediately():
+    """satellite: predict() on a closed batcher raises ServingClosedError
+    at once — it must never enqueue into a dead worker and hang."""
+    bst, X = _train()
+    eng = _engine(bst)
+    mb = MicroBatcher(eng, max_batch_rows=16, max_wait_ms=1.0)
+    np.testing.assert_array_equal(mb.predict(X[:2]), eng.predict(X[:2]))
+    mb.close()
+    t0 = time.monotonic()
+    with pytest.raises(ServingClosedError):
+        mb.predict(X[:1])
+    assert time.monotonic() - t0 < 1.0
+    # closed engine likewise
+    eng.close()
+    with pytest.raises(ServingClosedError):
+        eng.predict(X[:1])
+    with pytest.raises(ServingClosedError):
+        eng.reload(bst)
+    assert eng.health() == "down"
+
+
+def test_close_fails_all_queued_futures_with_concurrent_callers():
+    """satellite regression: close() under concurrent load fails every
+    still-queued request with ServingClosedError promptly — no caller is
+    left hanging on a dead worker."""
+    bst, X = _train()
+    eng = _engine(bst)
+    chaos = DispatchChaos()
+    eng.chaos = chaos
+    chaos.arm_hang(1.0, n=1)             # first batch wedges the worker
+    outcomes = {}
+    mb = MicroBatcher(eng, max_batch_rows=2, max_wait_ms=1.0)
+
+    def call(i):
+        t0 = time.monotonic()
+        try:
+            mb.predict(X[i:i + 2])
+            outcomes[i] = ("ok", time.monotonic() - t0)
+        except ServingClosedError:
+            outcomes[i] = ("closed", time.monotonic() - t0)
+        except ServingError as e:
+            outcomes[i] = (type(e).__name__, time.monotonic() - t0)
+
+    threads = [threading.Thread(target=call, args=(i,), daemon=True)
+               for i in range(6)]
+    for t in threads:
+        t.start()
+        time.sleep(0.05)
+    time.sleep(0.1)                      # several requests now queued
+    mb.close()
+    for t in threads:
+        t.join(timeout=15)
+    assert len(outcomes) == 6, outcomes
+    kinds = {k for k, _ in outcomes.values()}
+    assert "closed" in kinds, outcomes   # the queued ones were failed
+    for kind, dt in outcomes.values():
+        assert kind in ("ok", "closed"), outcomes
+        assert dt < 5.0, outcomes        # nobody hung on the dead worker
+    eng.close()
+
+
+# ------------------------------------------------- circuit breaker / health
+
+def test_breaker_degrades_and_probe_recovers():
+    """Dispatch failures trip the breaker to `degraded` (host-predictor
+    fallback, bit-identical), the background probe re-warms the device
+    path, and health() returns `ready` again."""
+    bst, X = _train()
+    eng = _engine(bst)
+    want = bst.predict(X[:80])
+    chaos = DispatchChaos()
+    eng.chaos = chaos
+    assert eng.health() == "ready"
+    chaos.arm_failures(3)
+    for _ in range(3):
+        # every request during the failure burst still answers correctly
+        np.testing.assert_array_equal(eng.predict(X[:80]), want)
+    assert eng.health() == "degraded"
+    assert eng.describe()["breaker"] == "open"
+    # degraded serving is bit-identical (host predictor)
+    np.testing.assert_array_equal(eng.predict(X[:80]), want)
+    assert _wait_for(lambda: eng.health() == "ready"), eng.health()
+    np.testing.assert_array_equal(eng.predict(X[:80]), want)
+    snap = obs.snapshot()
+    assert snap["counters"]["serve.breaker_trips"] == 1
+    assert snap["counters"]["serve.breaker_recoveries"] == 1
+    assert snap["counters"]["serve.host_fallback"] >= 3
+    assert snap["gauges"]["serve.health"] == 0
+    eng.close()
+
+
+def test_breaker_flap_reprobes_every_trip():
+    """A flapping device: trip -> probe recovery -> immediate re-trip must
+    start a fresh probe every time (the engine can never get stuck in
+    `degraded` with no probe running), and recover again."""
+    bst, X = _train()
+    eng = _engine(bst)
+    want = bst.predict(X[:40])
+    chaos = DispatchChaos()
+    eng.chaos = chaos
+    for cycle in range(3):
+        chaos.arm_failures(3)
+        for _ in range(3):
+            np.testing.assert_array_equal(eng.predict(X[:40]), want)
+        assert eng.health() == "degraded", f"cycle {cycle}"
+        assert _wait_for(lambda: eng.health() == "ready"), \
+            f"stuck degraded on cycle {cycle}"
+        np.testing.assert_array_equal(eng.predict(X[:40]), want)
+    snap = obs.snapshot()
+    assert snap["counters"]["serve.breaker_trips"] == 3
+    assert snap["counters"]["serve.breaker_recoveries"] == 3
+    eng.close()
+
+
+def test_breaker_window_and_disable():
+    """Unit: failures outside the sliding window never accumulate to a
+    trip; failures=0 disables the breaker entirely."""
+    t = [0.0]
+    br = CircuitBreaker(failures=3, window_s=10.0, clock=lambda: t[0])
+    assert br.record_failure() is False
+    t[0] = 1.0
+    assert br.record_failure() is False
+    t[0] = 12.0                          # first two age out of the window
+    assert br.record_failure() is False
+    assert not br.is_open
+    t[0] = 12.5
+    br.record_failure()
+    assert br.record_failure() is True   # 3 inside the window -> trip
+    assert br.is_open and br.state == "open"
+    br.reset()
+    assert not br.is_open
+    off = CircuitBreaker(failures=0, window_s=1.0, clock=lambda: 0.0)
+    for _ in range(50):
+        assert off.record_failure() is False
+    assert not off.is_open
+
+
+def test_single_failure_does_not_degrade():
+    """One transient dispatch failure falls back for THAT request only —
+    the breaker stays closed and the next request is back on device."""
+    bst, X = _train()
+    eng = _engine(bst, serve_breaker_failures=5)
+    chaos = DispatchChaos()
+    eng.chaos = chaos
+    chaos.arm_failures(1)
+    want = bst.predict(X[:20])
+    np.testing.assert_array_equal(eng.predict(X[:20]), want)
+    assert eng.health() == "ready"
+    before = chaos.dispatches
+    np.testing.assert_array_equal(eng.predict(X[:20]), want)
+    assert chaos.dispatches > before     # device path again, not host
+    eng.close()
+
+
+# ------------------------------------------------------------- hot reload
+
+def test_reload_swaps_verified_and_bumps_version():
+    bst1, X = _train(trees=10, seed=0)
+    bst2, _ = _train(trees=6, seed=7, num_leaves=7)
+    eng = _engine(bst1)
+    assert eng.describe()["model_version"] == 1
+    np.testing.assert_array_equal(eng.predict(X[:60]), bst1.predict(X[:60]))
+    v = eng.reload(bst2)
+    assert v == 2 and eng.describe()["model_version"] == 2
+    np.testing.assert_array_equal(eng.predict(X[:60]), bst2.predict(X[:60]))
+    snap = obs.snapshot()
+    assert snap["counters"]["serve.reloads"] == 1
+    assert "serve.reload_rollbacks" not in snap["counters"]
+    assert snap["gauges"]["serve.model_version"] == 2
+    eng.close()
+
+
+def test_reload_rolls_back_on_corrupted_candidate(monkeypatch):
+    """satellite: a candidate whose device walk disagrees with its own
+    Booster.predict (bit-level corruption) fails verification and rolls
+    back — the old model keeps serving untouched."""
+    import lightgbm_tpu.ops.predict as ops_predict
+    bst1, X = _train(trees=10, seed=0)
+    bst2, _ = _train(trees=6, seed=7)
+    eng = _engine(bst1)
+    want1 = bst1.predict(X[:60])
+    orig_walk = ops_predict.forest_walk_leaves
+
+    def corrupted_walk(*args):
+        return orig_walk(*args) * 0      # every row lands in leaf 0
+
+    # only the CANDIDATE state jits the corrupted symbol — the live
+    # model's walk was captured at engine construction
+    monkeypatch.setattr(ops_predict, "forest_walk_leaves", corrupted_walk)
+    with pytest.raises(ReloadError, match="verification FAILED"):
+        eng.reload(bst2, verify_rows=128)
+    monkeypatch.setattr(ops_predict, "forest_walk_leaves", orig_walk)
+    # rollback: still model_version 1, still serving the OLD bits
+    assert eng.describe()["model_version"] == 1
+    np.testing.assert_array_equal(eng.predict(X[:60]), want1)
+    snap = obs.snapshot()
+    assert snap["counters"]["serve.reload_rollbacks"] == 1
+    assert "serve.reloads" not in snap["counters"]
+    eng.close()
+
+
+@pytest.mark.slow
+def test_reload_rejects_feature_mismatch_and_rolls_back():
+    bst1, X = _train(trees=8, f=8)
+    bst_wrong, _ = _train(trees=8, f=5)
+    eng = _engine(bst1)
+    with pytest.raises(ReloadError, match="features"):
+        eng.reload(bst_wrong)
+    assert eng.describe()["model_version"] == 1
+    np.testing.assert_array_equal(eng.predict(X[:40]), bst1.predict(X[:40]))
+    assert obs.snapshot()["counters"]["serve.reload_rollbacks"] == 1
+    eng.close()
+
+
+@pytest.mark.slow
+def test_reload_under_open_loop_traffic_is_atomic():
+    """satellite: open-loop traffic through the MicroBatcher while
+    reload() swaps models — no request errors, and EVERY response matches
+    exactly one of the two model versions (never a mix)."""
+    bst1, X = _train(trees=10, seed=0)
+    bst2, _ = _train(trees=6, seed=7, num_leaves=7)
+    eng = _engine(bst1)
+    pool = X[:40]
+    exp1 = {n: bst1.predict(pool[:n]) for n in (2, 3, 5)}
+    exp2 = {n: bst2.predict(pool[:n]) for n in (2, 3, 5)}
+    stop = threading.Event()
+    versions_seen = set()
+    errors = []
+
+    with MicroBatcher(eng, max_batch_rows=16, max_wait_ms=1.0) as mb:
+        def worker(w):
+            sizes = [2, 3, 5]
+            i = 0
+            while not stop.is_set():
+                n = sizes[(w + i) % 3]
+                i += 1
+                try:
+                    out = mb.predict(pool[:n])
+                except Exception as e:                        # noqa: BLE001
+                    errors.append(repr(e))
+                    return
+                if np.array_equal(out, exp1[n]):
+                    versions_seen.add(1)
+                elif np.array_equal(out, exp2[n]):
+                    versions_seen.add(2)
+                else:
+                    errors.append(f"response matches NEITHER version "
+                                  f"(n={n})")
+                    return
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        v = eng.reload(bst2, verify_rows=64)
+        assert v == 2
+        time.sleep(0.3)                  # traffic continues on the new model
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+    assert errors == []
+    assert versions_seen == {1, 2}, versions_seen
+    eng.close()
+
+
+@pytest.mark.slow
+def test_reload_under_load_rollback_keeps_old_version(monkeypatch):
+    """satellite: a deliberately corrupted candidate under live load rolls
+    back and traffic never leaves the old version."""
+    import lightgbm_tpu.ops.predict as ops_predict
+    bst1, X = _train(trees=10, seed=0)
+    bst2, _ = _train(trees=6, seed=7)
+    eng = _engine(bst1)
+    pool = X[:30]
+    exp1 = bst1.predict(pool[:3])
+    stop = threading.Event()
+    errors = []
+
+    orig_walk = ops_predict.forest_walk_leaves
+    with MicroBatcher(eng, max_batch_rows=16, max_wait_ms=1.0) as mb:
+        def worker():
+            while not stop.is_set():
+                try:
+                    out = mb.predict(pool[:3])
+                except Exception as e:                        # noqa: BLE001
+                    errors.append(repr(e))
+                    return
+                if not np.array_equal(out, exp1):
+                    errors.append("response left the OLD version despite "
+                                  "rollback")
+                    return
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        monkeypatch.setattr(ops_predict, "forest_walk_leaves",
+                            lambda *a: orig_walk(*a) * 0)
+        with pytest.raises(ReloadError):
+            eng.reload(bst2, verify_rows=64)
+        monkeypatch.setattr(ops_predict, "forest_walk_leaves", orig_walk)
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+    assert errors == []
+    assert eng.describe()["model_version"] == 1
+    eng.close()
+
+
+# ------------------------------------------------------------ config/ledger
+
+def test_resilience_knobs_validated():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        Config.from_params({"serve_max_queue_rows": -1})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"serve_deadline_ms": -2})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"serve_breaker_failures": -1})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"serve_breaker_window_s": 0})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"serve_probe_interval_s": 0})
+    cfg = Config.from_params({"serve_max_queue_rows": 128,
+                              "serve_deadline_ms": 25.0, "verbose": -1})
+    assert cfg.serve_max_queue_rows == 128
+    assert cfg.serve_deadline_ms == 25.0
+    # all resilience knobs are checkpoint-volatile (inference policy only)
+    from lightgbm_tpu.robustness.checkpoint import VOLATILE_CONFIG_FIELDS
+    for k in ("serve_max_queue_rows", "serve_deadline_ms",
+              "serve_breaker_failures", "serve_breaker_window_s",
+              "serve_probe_interval_s"):
+        assert k in VOLATILE_CONFIG_FIELDS, k
+
+
+def test_ledger_serve_chaos_key_and_gates():
+    """SERVE_CHAOS entries key on |serve_chaos= (never judged against
+    training or plain serving numbers) and regress on shed-rate ceiling
+    and p99-under-overload."""
+    from lightgbm_tpu.observability import ledger
+    chaos = {"metric": "serve_chaos", "value": 30000.0, "unit": "rows/s",
+             "platform": "cpu", "rows": 8000, "kernel": "xla",
+             "n_devices": 1, "serve_chaos": "open|b4|overload",
+             "shed_rate": 0.30, "p99_ms": 50.0,
+             "recompiles_post_warmup": 0}
+    e = ledger.normalize_bench(chaos, "SERVE_CHAOS_r01.json", 1)
+    assert e["serve_chaos"] == "open|b4|overload"
+    assert e["shed_rate"] == 0.30
+    key = ledger.comparability_key(e)
+    assert "|serve_chaos=open|b4|overload" in key
+    serve_e = ledger.normalize_bench(
+        {"metric": "serve_bench", "value": 50000.0, "platform": "cpu",
+         "rows": 8000, "kernel": "xla", "n_devices": 1,
+         "serve": "closed|b512xc2"}, "SERVE_r01.json", 1)
+    assert ledger.comparability_key(serve_e) != key
+    hist = [e]
+    # shed-rate ceiling: shedding far MORE than best-known is a capacity
+    # regression even when throughput holds
+    bad_shed = dict(chaos, shed_rate=0.85)
+    problems, _ = ledger.compare(bad_shed, hist)
+    assert any("shed-rate regression" in p for p in problems), problems
+    # p99-under-overload rides the p99 band
+    bad_p99 = dict(chaos, p99_ms=500.0)
+    problems, _ = ledger.compare(bad_p99, hist)
+    assert any("p99 latency regression" in p for p in problems)
+    good = dict(chaos, shed_rate=0.32, p99_ms=55.0)
+    problems, _ = ledger.compare(good, hist)
+    assert problems == [], problems
+
+
+def test_health_metrics_and_describe_fields():
+    bst, X = _train(trees=6)
+    eng = _engine(bst)
+    d = eng.describe()
+    assert d["health"] == "ready" and d["breaker"] == "closed"
+    assert d["model_version"] == 1
+    snap = obs.snapshot()
+    assert snap["gauges"]["serve.health"] == 0
+    assert snap["gauges"]["serve.model_version"] == 1
+    eng.close()
+    assert eng.health() == "down"
+    assert obs.snapshot()["gauges"]["serve.health"] == 2

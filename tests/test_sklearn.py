@@ -40,6 +40,7 @@ def test_classifier_proba_and_classes():
     assert acc > 0.85, acc
 
 
+@pytest.mark.slow
 def test_multiclass():
     rng = np.random.RandomState(2)
     X = rng.rand(1500, 6)
@@ -87,6 +88,7 @@ def test_dart_boosting():
     assert mse < float(np.var(yte)) * 0.5, mse
 
 
+@pytest.mark.slow
 def test_grid_search():
     from sklearn.model_selection import GridSearchCV
     Xtr, ytr, _, _ = _reg_data(n=600, seed=5)
@@ -141,6 +143,7 @@ def test_predict_proba_custom_objective_returns_raw_unchanged():
     np.testing.assert_array_equal(clf.predict(X), proba)
 
 
+@pytest.mark.slow
 def test_seed_alias_matches_random_state():
     """Reference test_sklearn.py:175-183: `seed=` (passed through kwargs)
     and `random_state=` are the same parameter; identical values must give
@@ -160,6 +163,7 @@ def test_seed_alias_matches_random_state():
     assert np.abs(p1 - p3).max() > 0
 
 
+@pytest.mark.slow
 def test_sklearn_estimator_checks_fast_subset():
     """Fast subset of sklearn's check_estimator battery — the checks that
     drove the wrapper's validation layer (NotFittedError, n_features_in_,
@@ -184,6 +188,7 @@ def test_sklearn_estimator_checks_fast_subset():
     ec.check_supervised_y_2d("LGBMClassifier", clf)
 
 
+@pytest.mark.slow
 def test_classifier_eval_set_and_class_weight_use_original_labels():
     """eval_set targets are encoded through the training label map (string
     labels + early stopping work end-to-end), and class_weight dicts are

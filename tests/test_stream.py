@@ -130,7 +130,8 @@ def test_store_interleaves_per_device_blocks():
 
 # ------------------------------------------------------- bit-identity pins
 
-@pytest.mark.parametrize("tree_learner", ["serial", "data"])
+@pytest.mark.parametrize("tree_learner", [
+    "serial", pytest.param("data", marks=pytest.mark.slow)])
 def test_stream_vs_device_bit_identical(tree_learner):
     """Streamed vs resident, serial and data-parallel on the 8-device
     harness, with bagging + feature_fraction engaged — the acceptance
@@ -153,6 +154,7 @@ def test_stream_u4_code_mode_bit_identical():
     _assert_identical(b_st, b_dev, X)
 
 
+@pytest.mark.slow
 def test_stream_categorical_valid_sets_bit_identical():
     """Categorical routing (the map_mask leg of _route_rows) and attached
     valid sets (resident in the streamed apply leg) both match the device
@@ -184,6 +186,7 @@ def test_stream_categorical_valid_sets_bit_identical():
     assert evs == evd
 
 
+@pytest.mark.slow
 def test_stream_multiclass_bit_identical():
     rng = np.random.RandomState(4)
     X = rng.rand(1200, 6).astype(np.float32)
@@ -200,6 +203,7 @@ def test_stream_multiclass_bit_identical():
     np.testing.assert_array_equal(bs.predict(X), bd.predict(X))
 
 
+@pytest.mark.slow
 def test_stream_shard_size_never_changes_the_model():
     """Shard size is pure transport: any value yields the same model —
     the invariant that makes the knob checkpoint-volatile."""
@@ -248,6 +252,7 @@ def test_stream_preflight_counts_shards_not_full_codes():
 
 # -------------------------------------------------------- checkpoint/resume
 
+@pytest.mark.slow
 def test_stream_kill_and_resume_bit_identical():
     """Train 3 + resume 3 == train 6, with the resumed booster using a
     DIFFERENT shard size, and separately resuming into DEVICE residency —

@@ -42,7 +42,8 @@ def _train(X, y, tree_batch, tree_learner="serial", rounds=10, **extra):
     return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds)
 
 
-@pytest.mark.parametrize("tree_learner", ["serial", "data"])
+@pytest.mark.parametrize("tree_learner", [
+    "serial", pytest.param("data", marks=pytest.mark.slow)])
 def test_tree_batch_bit_identical(tree_learner):
     # rounds=10, K=4 exercises full batches AND the final partial batch (2)
     X, y = _make_binary()
@@ -58,6 +59,7 @@ def test_tree_batch_bit_identical(tree_learner):
         np.testing.assert_array_equal(t1.split_feature, t4.split_feature)
 
 
+@pytest.mark.slow
 def test_tree_batch_eight_with_eval_history():
     # K=8 with a valid set: eval lands on batch boundaries only, and the
     # recorded values must equal the K=1 run's values at those iterations
@@ -244,6 +246,7 @@ def test_tree_batch_custom_objective_falls_back():
     assert len(bst.trees) == 3                     # one tree per iteration
 
 
+@pytest.mark.slow
 def test_tree_batch_checkpoint_resume_bit_identical(tmp_path):
     """Checkpoints land on batch boundaries; a resumed batched run must
     finish bit-identical to the uninterrupted one."""
