@@ -48,6 +48,19 @@ stream:
 	env LGBM_TPU_STREAM_ROWS=20000 LGBM_TPU_STREAM_ITERS=5 \
 	    python bench.py --stream
 
+# Wide-sparse (Bosch-shaped) EFB phase, three arms: bundlespace (native
+# bundle-space scan/routing — the default), efb_unpack (legacy
+# tpu_efb_unpack=true A/B arm that measured the round-5 3.5x loss), noefb
+# (enable_bundle=false). The bundlespace arm must at least match noefb
+# throughput with a lower peak (docs/TPU-Performance.md "EFB on TPU").
+# Bank with LGBM_TPU_SPARSE_OUT=SPARSE_r<N>.json; `bench.py --compare`
+# judges the newest banked file under the |bundle= comparability key.
+# Full Bosch scale: LGBM_TPU_BENCH_SPARSE_ROWS=1000000 \
+#   LGBM_TPU_BENCH_SPARSE_FEATS=968 make sparse (tunnel-window sized).
+sparse:
+	env LGBM_TPU_BENCH_PLATFORM=cpu LGBM_TPU_BENCH_SPARSE_ROWS=60000 \
+	    LGBM_TPU_BENCH_SPARSE_FEATS=256 python bench.py --sparse
+
 # Serving smoke (docs/Serving.md): hermetic-CPU train -> protobuf ->
 # ServingEngine round trip asserting bit-identity with the training
 # booster's predict(), zero jit cache misses across closed + open
@@ -137,4 +150,4 @@ trace:
 	@echo "trace: $$(ls -1t .telemetry/trace_*.json | head -1)"
 
 .PHONY: lint verify check-fast check capi bench-cpu chaos bench-chaos \
-        trace bench-diff ledger multichip stream serve serve-chaos
+        trace bench-diff ledger multichip stream serve serve-chaos sparse

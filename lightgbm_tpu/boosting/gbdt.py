@@ -176,10 +176,17 @@ class GBDT:
         # ---- EFB bundling (reference Dataset::Construct enable_bundle path,
         #      dataset.cpp:236-247): pack near-exclusive features into fewer
         #      histogram columns, for EVERY learner strategy — EFB precedes
-        #      learner choice in the reference too (dataset.cpp:66-210):
-        #      - serial + row-sharded (data/voting): plan is deterministic and
-        #        every process holds the full matrix; the grower unpacks to
-        #        original feature space before the collective (grower.py);
+        #      learner choice in the reference too (dataset.cpp:66-210).
+        #      NATIVE default: bundle space is the representation end-to-end
+        #      — the split scan runs on bundled bins directly
+        #      (ops/split_finder.per_feature_best_bundled, the reference's
+        #      FeatureGroup discipline), data-parallel reduce-scatters
+        #      bundle-column blocks (DataParallelBundledComm), voting psums
+        #      selected bundle columns, and row routing compares bundled
+        #      codes against the split's bundle range. The legacy
+        #      tpu_efb_unpack arm keeps the pre-redesign layout (unpack to
+        #      [T, F, B, 3] before the scan; per-row decode in routing) as
+        #      the A/B + parity pin.
         #      - feature-parallel: BUNDLES are the partitioned unit
         #        (FeatureParallelBundledComm — the reference partitions
         #        post-EFB feature groups the same way);
@@ -190,7 +197,20 @@ class GBDT:
         #        shard against the common plan. ----
         self.bundle = None
         bundle_plan = None
-        if config.enable_bundle and F >= 2:
+        # legacy unpack arm (tpu_efb_unpack). The one unsupported native
+        # combination — voting + categorical (the PV-Tree phase-2
+        # selected-column scan is numerical-only in bundle space,
+        # parallel/comm.py scan_slot_b) — forces the legacy arm HERE,
+        # before any engagement logging/warning reads the arm, rather
+        # than silently dropping categorical candidates; the warning
+        # fires below only if bundling actually engages
+        self._efb_unpack = bool(config.tpu_efb_unpack)
+        _efb_unpack_forced = False
+        if (not self._efb_unpack and self.pctx.strategy == "voting"
+                and bool(meta["is_categorical"].any())):
+            self._efb_unpack = True
+            _efb_unpack_forced = True
+        if config.enable_bundle != "false" and F >= 2:
             from ..efb import _SAMPLE_ROWS, plan_bundles, sample_rows
             efb_sample = None
             efb_ndata = None
@@ -207,38 +227,58 @@ class GBDT:
                                 sample=efb_sample, num_data=efb_ndata)
             if plan is not None:
                 Bb_pad = max(8, _round_up(plan.max_bundle_bins, 8))
-                # bundle when it shrinks the one-hot matmul (G*Bb < F*B), OR
-                # when it at least halves the column count without growing
-                # the matmul much: the per-wave row gather and the HBM
-                # footprint scale with raw column count, so a Bosch-shaped
-                # matrix (many low-bin exclusive columns) still wins even at
-                # equal matmul width — EFB's "densifier" role for sparse
-                # data (dataset.cpp:236-247, sparse_bin.hpp:68)
+                # the BundlePlan win ratio: bundling wins when it shrinks
+                # the one-hot matmul (G*Bb < F*B), OR when it at least
+                # halves the column count without growing the matmul much
+                # — the per-wave row gather and the HBM footprint scale
+                # with raw column count, so a Bosch-shaped matrix (many
+                # low-bin exclusive columns) wins even at equal matmul
+                # width, EFB's "densifier" role for sparse data
+                # (dataset.cpp:236-247, sparse_bin.hpp:68). With the
+                # bundle-space scan the decode tax the round-5 bench
+                # measured is gone, so this ratio IS the crossover:
+                # enable_bundle=auto resolves per shape class the way
+                # tpu_hist_kernel=auto does, enable_bundle=true engages
+                # any plan regardless.
                 shrinks_matmul = plan.num_groups * Bb_pad < 0.9 * F * Bpad
                 shrinks_cols = (plan.num_groups * 2 <= F
                                 and plan.num_groups * Bb_pad <= 1.25 * F * Bpad)
-                if shrinks_matmul or shrinks_cols:
+                wins = shrinks_matmul or shrinks_cols
+                if config.enable_bundle == "auto":
+                    Log.debug(
+                        "enable_bundle=auto resolved to %s (%d features -> "
+                        "%d bundles, matmul %d vs %d columns)",
+                        "true" if wins else "false", F, plan.num_groups,
+                        plan.num_groups * Bb_pad, F * Bpad)
+                if wins or config.enable_bundle == "true":
                     bundle_plan = plan
+                    if _efb_unpack_forced:
+                        Log.warning(
+                            "tree_learner=voting with categorical features "
+                            "keeps the legacy EFB unpack arm "
+                            "(tpu_efb_unpack=true forced)")
                     Log.info("EFB: %d features bundled into %d columns "
-                             "(%d max bundle bins)", F, plan.num_groups,
-                             plan.max_bundle_bins)
+                             "(%d max bundle bins), scan=%s", F,
+                             plan.num_groups, plan.max_bundle_bins,
+                             "unpack (legacy tpu_efb_unpack arm)"
+                             if self._efb_unpack else "bundle-space")
                     if (self.pctx.devices[0].platform == "tpu"
+                            and self._efb_unpack
                             and not _EFB_TPU_WARNED[0]):
-                        # round-5 on-chip measurement (exp/HARVEST_r5.jsonl,
-                        # docs/TPU-Performance.md): the Bosch-shaped bench
-                        # ran at 1.1 Mrow-tree/s WITH EFB vs 3.8 without —
-                        # the per-row bundle decode in routing/unpack
-                        # dominates the wave on TPU even though the matmul
-                        # shrinks. EFB still wins on HBM footprint.
+                        # the round-5 "EFB hurts on TPU" warning is RETIRED
+                        # on the default arm: bundle-space split finding
+                        # removed the decode gather it measured (1.1 vs 3.8
+                        # Mrow-tree/s, exp/HARVEST_r5.jsonl). Only the
+                        # legacy unpack arm still pays that layout.
                         _EFB_TPU_WARNED[0] = True
                         Log.warning(
-                            "EFB engaged on the TPU backend: round-5 "
-                            "measured a 3.5x throughput LOSS on the "
-                            "Bosch-shaped benchmark (1.1 vs 3.8 "
-                            "Mrow-tree/s — bundle decode dominates; "
-                            "docs/TPU-Performance.md). Set "
-                            "enable_bundle=false unless HBM footprint is "
-                            "the constraint")
+                            "tpu_efb_unpack=true on the TPU backend: the "
+                            "legacy unpack arm measured a 3.5x throughput "
+                            "LOSS on the round-5 Bosch-shaped benchmark "
+                            "(1.1 vs 3.8 Mrow-tree/s — bundle decode "
+                            "dominates; docs/TPU-Performance.md). It "
+                            "exists as the A/B + parity arm; drop the "
+                            "knob for the bundle-space default")
 
         # ---- histogram kernel choice (needs the FINAL kernel shape class,
         #      hence after EFB planning). "auto" resolves to the MIXED
@@ -260,10 +300,15 @@ class GBDT:
         # by the bundle materialization below — recomputing them there
         # risked the dispatched shape diverging from what was decided here)
         if bundle_plan is not None:
-            # feature-parallel partitions BUNDLE blocks: G % devices == 0
-            cols_pad = (self.pctx.pad_features_to(bundle_plan.X_bundled.shape[1])
-                        if self.pctx.strategy == "feature"
-                        else bundle_plan.X_bundled.shape[1])
+            G_raw = bundle_plan.X_bundled.shape[1]
+            if self.pctx.strategy == "feature" or (
+                    self.pctx.strategy == "data" and not self._efb_unpack):
+                # bundle blocks are the partition unit (feature-parallel
+                # always; data-parallel on the native arm, where the
+                # psum_scatter runs over bundle blocks): G % devices == 0
+                cols_pad = self.pctx.pad_features_to(G_raw)
+            else:
+                cols_pad = G_raw
         else:
             cols_pad = F_pad
         chunk = min(config.tpu_hist_chunk, _round_up(per_target, 256))
@@ -366,13 +411,17 @@ class GBDT:
             ub = np.pad(bundle_plan.unpack_bin,
                         ((0, fpad), (0, Bpad - bundle_plan.unpack_bin.shape[1])),
                         constant_values=-1)
+            from ..efb import build_code_feat
             from ..grower import BundleDecode
+            cf = build_code_feat(bundle_plan, cols_pad, Bb_pad,
+                                 meta["default_bin"].astype(np.int64))
             self.bundle = BundleDecode(
                 col=self._put(np.pad(bundle_plan.col, (0, fpad))),
                 lo=self._put(np.pad(bundle_plan.lo, (0, fpad))),
                 hi=self._put(np.pad(bundle_plan.hi, (0, fpad))),
                 off=self._put(np.pad(bundle_plan.off, (0, fpad))),
-                unpack_bin=self._put(ub))
+                unpack_bin=self._put(ub),
+                code_feat=self._put(cf))
             self._hist_bins = Bb_pad
         else:
             Xb = train_set.X_binned
@@ -551,8 +600,10 @@ class GBDT:
             hist_hilo=config.tpu_hist_hilo,
             hist_f64=config.tpu_hist_f64,
             hist_bins=self._hist_bins,
+            efb_unpack=(self.bundle is not None and self._efb_unpack),
             code_mode=code_mode,
             use_categorical=bool(meta["is_categorical"].any()),
+            cat_features=tuple(int(i) for i in np.nonzero(is_cat_pad)[0]),
             cat_smooth=config.cat_smooth,
             cat_l2=config.cat_l2,
             max_cat_threshold=config.max_cat_threshold,
@@ -561,8 +612,14 @@ class GBDT:
         )
         self.comm = self.pctx.make_comm(
             F_pad,
+            # bundle blocks are the partition unit for feature-parallel
+            # (both EFB arms) and for data-parallel on the NATIVE arm,
+            # where the psum_scatter itself runs in bundle space
             num_bundles=(self._num_bundles_padded
-                         if self.pctx.strategy == "feature" else 0),
+                         if (self.pctx.strategy == "feature"
+                             or (self.pctx.strategy == "data"
+                                 and self.bundle is not None
+                                 and not self._efb_unpack)) else 0),
             bundle_col=None if self.bundle is None else self.bundle.col)
         if self.residency == "stream":
             from ..grower import StreamedGrower
@@ -723,7 +780,13 @@ class GBDT:
             obs.event("mesh_axes", **self.pctx.describe())
         comm_bytes = self.comm.collective_bytes(
             self.spec.hist_slots, Bpad,
-            use_categorical=self.spec.use_categorical)
+            use_categorical=self.spec.use_categorical,
+            # native bundled runs move BUNDLE-space histograms through the
+            # wave collectives; the legacy unpack arm reduces feature-space
+            # histograms (unbundle-early), so it keeps the default widths
+            hist_bins=(self._hist_bins
+                       if (self.bundle is not None and not self._efb_unpack)
+                       else None))
         for cname, nbytes in comm_bytes.items():
             reg.gauge(f"comm.bytes_per_wave.{cname}").set(nbytes)
         if comm_bytes:
@@ -1014,8 +1077,10 @@ class GBDT:
         K = self.num_models
         comm = self.comm
 
-        bundle = self.bundle              # EFB: serial + data/voting (grower
-                                          # unpacks before the collective)
+        bundle = self.bundle              # EFB: native arm scans/routes in
+                                          # bundle space end-to-end; legacy
+                                          # tpu_efb_unpack unpacks before
+                                          # the collective (grower.py)
 
         def grow_fn(X, g, h, inc, fok, iscat, nb, mc, db):
             return grow_tree(X, g, h, inc, fok, iscat, nb, mc, db, spec, comm,

@@ -192,7 +192,16 @@ class Config:
     is_predict_raw_score: bool = False
     min_data_in_bin: int = 3
     max_conflict_rate: float = 0.0
-    enable_bundle: bool = True
+    # EFB (exclusive feature bundling, efb.py): "auto" (the default)
+    # resolves per shape class — bundle iff the plan actually shrinks the
+    # histogram work (the BundlePlan win ratio, boosting/gbdt.py), the way
+    # tpu_hist_kernel=auto resolves per shape class; "true" bundles
+    # whenever any plan exists; "false" disables. Since the bundle-space
+    # split-finding redesign the scan, the collectives, and row routing all
+    # run on bundled bins natively (ops/split_finder.py
+    # per_feature_best_bundled) — the round-5 "EFB hurts on TPU" regression
+    # this knob used to warn about is gone on the default arm.
+    enable_bundle: str = "auto"
     has_header: bool = False
     label_column: str = ""
     weight_column: str = ""
@@ -322,6 +331,14 @@ class Config:
     # legacy per-wave argsort rebuild (bit-identical — the A/B + parity pin,
     # tests/test_incremental_partition.py)
     tpu_incremental_partition: bool = True
+    # LEGACY EFB scan arm: unpack bundle-space histograms into full
+    # [T, F, B, 3] feature space before split finding and route rows
+    # through the per-row bundle-decode gather — the pre-redesign layout
+    # that measured 3.5x SLOWER on the round-5 Bosch-shaped sparse bench
+    # (1.1 vs 3.8 Mrow-tree/s; docs/TPU-Performance.md). Kept as the A/B +
+    # parity arm for the native bundle-space scan
+    # (tests/test_efb_bundlespace.py); requires enable_bundle != false.
+    tpu_efb_unpack: bool = False
     # --- out-of-core streaming (ops/stream.py, docs/TPU-Performance.md) ----
     # where the binned code matrix LIVES during training:
     #   device — fully HBM-resident (the historical behavior)
@@ -508,6 +525,31 @@ class Config:
                             "shards the %s axis by definition (the knob only "
                             "constrains tree_learner=auto)",
                             self.tpu_mesh_axis, self.tree_learner, expected)
+        # enable_bundle is a tri-state: bools and their string spellings
+        # normalize onto "true"/"false", everything else must be "auto"
+        eb = str(self.enable_bundle).lower()
+        if eb in ("true", "+", "1"):
+            eb = "true"
+        elif eb in ("false", "-", "0"):
+            eb = "false"
+        if eb not in ("auto", "true", "false"):
+            Log.fatal('Parameter enable_bundle should be "auto", "true" or '
+                      '"false", got "%s"', self.enable_bundle)
+        self.enable_bundle = eb
+        if not 0.0 <= self.max_conflict_rate < 1.0:
+            # the conflict budget is a row FRACTION (reference
+            # max_conflict_rate, dataset.cpp:152): 1.0+ would admit bundles
+            # whose members collide on every sampled row, and negative
+            # values silently disable bundling through an int() truncation
+            Log.fatal("max_conflict_rate must be in [0, 1), got %g",
+                      self.max_conflict_rate)
+        if self.tpu_efb_unpack and self.enable_bundle == "false":
+            # reject loudly instead of silently ignoring the knob: the
+            # legacy unpack arm only exists as the A/B + parity arm OF
+            # bundling — asking for it with bundling off is a contradiction
+            Log.fatal("tpu_efb_unpack=true requires enable_bundle=auto|true "
+                      "(the unpack arm is the legacy layout OF bundling; "
+                      "with enable_bundle=false there is nothing to unpack)")
         if self.tpu_hist_kernel not in ("auto", "xla", "pallas", "mixed"):
             Log.fatal("Unknown tpu_hist_kernel %s (auto|xla|pallas|mixed)",
                       self.tpu_hist_kernel)

@@ -102,6 +102,33 @@ def _find_groups(masks: np.ndarray, counts: np.ndarray, order: np.ndarray,
     return feats
 
 
+def build_code_feat(plan: "BundlePlan", cols_pad: int, bins_pad: int,
+                    default_bin: np.ndarray) -> np.ndarray:
+    """[cols_pad, bins_pad] i32 inverse code map: the member feature owning
+    each bundle code, -1 for unowned positions.
+
+    The native bundle-space split scan (ops/split_finder.py
+    per_feature_best_bundled) is driven by this table: code 0 (all members
+    at default), bin padding, and the default-bin hole at
+    ``off[f] + default_bin[f]`` are unowned — the default bin's mass is
+    never stored (reference FeatureGroup encoding, feature_group.h:30-52)
+    and is reconstructed by subtraction at scan time. For shift-1 members
+    (default bin 0) the hole position ``lo - 1`` falls OUTSIDE the member's
+    range and must not clobber the neighbouring member's last code, hence
+    the in-range test."""
+    F = plan.col.shape[0]
+    cf = np.full((cols_pad, bins_pad), -1, np.int32)
+    for f in range(F):
+        g, lo, hi, off = (int(plan.col[f]), int(plan.lo[f]),
+                          int(plan.hi[f]), int(plan.off[f]))
+        if hi > lo:
+            cf[g, lo:hi] = f
+            hole = off + int(default_bin[f])
+            if lo <= hole < hi:
+                cf[g, hole] = -1
+    return cf
+
+
 def sample_rows(X_binned: np.ndarray, max_rows: int = _SAMPLE_ROWS,
                 rng_seed: int = 1) -> np.ndarray:
     """Deterministic row sample for conflict estimation. Exposed so the

@@ -81,13 +81,20 @@ class BundleDecode(NamedTuple):
     ``orig_bin = code - off[f] if lo[f] <= code < hi[f] else default_bin[f]``.
     ``unpack_bin[f, b]`` is the bundle-bin holding original bin b (-1 for the
     default bin — reconstructed by subtraction, the reference's FixHistogram,
-    dataset.cpp:750-769).
+    dataset.cpp:750-769); only the legacy ``tpu_efb_unpack=true`` arm reads
+    it. ``code_feat[g, c]`` is the inverse map the NATIVE bundle-space scan
+    (ops/split_finder.per_feature_best_bundled) is driven by: the member
+    feature owning code c of bundled column g, -1 for unowned positions
+    (code 0, bin padding, and the default-bin hole at ``off[f] +
+    default_bin[f]`` — its mass is reconstructed by subtraction, never
+    stored).
     """
     col: jnp.ndarray          # i32 [F]
     lo: jnp.ndarray           # i32 [F]
     hi: jnp.ndarray           # i32 [F]
     off: jnp.ndarray          # i32 [F]
     unpack_bin: jnp.ndarray   # i32 [F, B]
+    code_feat: jnp.ndarray    # i32 [G, Bb]
 
 
 def decode_bundled_bin(Xb: jnp.ndarray, f: jnp.ndarray,
@@ -179,6 +186,14 @@ class GrowerSpec:
                                   # are sized to N/4 — keep <= 0.25 there
     hist_bins: int = 0            # bin axis of the histogram BUILD (EFB bundle
                                   # space); 0 = num_bins_padded (unbundled)
+    efb_unpack: bool = False      # LEGACY EFB scan arm (tpu_efb_unpack):
+                                  # unpack bundle-space histograms to
+                                  # [T, F, B, 3] before the split scan and
+                                  # route rows through the per-row
+                                  # decode_bundled_bin gather. False (the
+                                  # default) scans and routes in bundle
+                                  # space natively — the A/B + parity pin
+                                  # is tests/test_efb_bundlespace.py
     code_mode: Optional[str] = None  # packed-row code layout (histogram.py
                                   # code_mode_for): u8 | u16 | u4 | u6;
                                   # None = plain byte layout by X dtype
@@ -192,6 +207,13 @@ class GrowerSpec:
                                   # (bin.h:29-31); xla kernel only
     # categorical split search (reference config.h:230-234)
     use_categorical: bool = False
+    cat_features: tuple = ()      # STATIC inner indices of categorical
+                                  # features — the native EFB arm's cat
+                                  # scan unpacks ONLY these members'
+                                  # bundle columns (a [T, Fc, B, 3]
+                                  # gather instead of re-paying the full
+                                  # [T, F, B, 3] decode the redesign
+                                  # deleted); empty when none
     cat_smooth: float = 10.0
     cat_l2: float = 10.0
     max_cat_threshold: int = 32
@@ -251,16 +273,16 @@ def _unpack_bundled(hist_g: jnp.ndarray, bundle: BundleDecode,
     """EFB unpack: [T, G, Bb, 3] bundle-space histograms -> [T, F, B, 3]
     original-feature space, reconstructing each feature's default bin by
     subtraction from the leaf totals (reference Dataset::FixHistogram,
-    dataset.cpp:750-769 — applied per scanned feature there too)."""
-    ub = bundle.unpack_bin                           # [F, B]
-    h = hist_g[:, bundle.col]                        # [T, F, Bb, 3]
-    idx = jnp.maximum(ub, 0)[None, :, :, None]
-    hf = jnp.take_along_axis(h, idx, axis=2)         # [T, F, B, 3]
-    hf = jnp.where((ub >= 0)[None, :, :, None], hf, 0.0)
-    totals = jnp.stack([pg, ph, pc], axis=-1)        # [T, 3]
-    deficit = totals[:, None, :] - hf.sum(axis=2)    # [T, F, 3]
-    F = ub.shape[0]
-    return hf.at[:, jnp.arange(F), default_bin, :].add(deficit)
+    dataset.cpp:750-769 — applied per scanned feature there too).
+
+    LEGACY arm only (``tpu_efb_unpack=true``): the default path scans the
+    bundle-space histogram natively (ops/split_finder.py
+    per_feature_best_bundled) and never materializes this [T, F, B] decode
+    — the gather here dominated the round-5 sparse wave and was the whole
+    3.5x EFB-on-TPU loss."""
+    from .ops.split_finder import unpack_bundled_hist
+    return unpack_bundled_hist(hist_g, bundle.col, bundle.unpack_bin,
+                               pg, ph, pc, default_bin)
 
 
 def _empty_cand(L: int, B: int) -> SplitCandidates:
@@ -281,18 +303,28 @@ def _apply_wave_splits(state: GrowState, new_hist: jnp.ndarray,
                        leaf_of_slot: jnp.ndarray, bm, spec: "GrowerSpec",
                        comm, scan_bundle: Optional[BundleDecode],
                        num_bins: jnp.ndarray, missing_code: jnp.ndarray,
-                       default_bin: jnp.ndarray):
+                       default_bin: jnp.ndarray,
+                       route_bundle: Optional[BundleDecode] = None):
     """Steps 3-6 of one wave — cache write + sibling subtraction, split
-    scan, split choice, tree/leaf-state apply — plus the [L+1, 6] routing
-    table and categorical left-set mask the per-row routing pass consumes.
+    scan, split choice, tree/leaf-state apply — plus the [L+1, 6|11]
+    routing table and categorical left-set mask the per-row routing pass
+    consumes.
 
     Shared VERBATIM by the resident wave body (``grow_tree``) and the
     streamed ``wave_update`` (``StreamedGrower``): residency is a transport
     decision, so the split math must have exactly one home or the two
     modes drift apart bit by bit. ``new_hist`` arrives post-``reduce_hist``
     (and post-early-unbundle where that applies); ``scan_bundle`` is the
-    EFB decode table ONLY when the split scan itself must unpack (serial /
-    bundled-block layouts), else None.
+    EFB decode table when the histograms are bundle-space — with
+    ``spec.efb_unpack`` the LEGACY arm unpacks them to feature space here
+    (serial / bundled-block layouts), otherwise the scan runs natively on
+    bundle space (comm.find_splits -> per_feature_best_bundled) and only
+    the winning (bundled column, bundle bin) is translated back to
+    (original feature, original bin) — the reference's FeatureGroup
+    discipline. ``route_bundle`` (native arm only, GLOBAL tables) extends
+    the routing table with the split feature's bundle column/range so the
+    routing pass compares bundled codes directly instead of gathering a
+    per-row decode.
 
     Returns ``(state', table, map_mask, p, q, n_apply)`` with ``state'``
     carrying every field EXCEPT the per-row ones (leaf_id and the
@@ -319,17 +351,23 @@ def _apply_wave_splits(state: GrowState, new_hist: jnp.ndarray,
     # ---- 4. split scan for the 2S touched leaves -----------------------
     scan_leaves = jnp.concatenate([leaf_of_slot, jnp.where(slot_valid, sibs, L)])
     scan_hist = jnp.concatenate([new_hist, sib_hist], axis=0)  # [2S, F, B, 3]
+    find_bundle = None
     if scan_bundle is not None:
-        scan_hist = _unpack_bundled(
-            scan_hist, scan_bundle, state.sum_g[scan_leaves],
-            state.sum_h[scan_leaves], state.cnt[scan_leaves], default_bin)
+        if spec.efb_unpack:
+            # legacy arm: materialize the [2S, F, B, 3] feature-space
+            # decode (the gather the native path exists to delete)
+            scan_hist = _unpack_bundled(
+                scan_hist, scan_bundle, state.sum_g[scan_leaves],
+                state.sum_h[scan_leaves], state.cnt[scan_leaves], default_bin)
+        else:
+            find_bundle = scan_bundle
     # candidate features are GLOBAL indices; under feature/data
     # parallelism this ends in an all-gather argmax across devices
     # (reference SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207)
     cand_new = comm.find_splits(
         scan_hist,
         state.sum_g[scan_leaves], state.sum_h[scan_leaves], state.cnt[scan_leaves],
-        bm, spec)
+        bm, spec, bundle=find_bundle)
     cand = SplitCandidates(*[
         old.at[scan_leaves].set(new) for old, new in zip(state.cand, cand_new)])
     cand = cand._replace(gain=cand.gain.at[L].set(NEG_INF))  # keep scratch row inert
@@ -416,19 +454,32 @@ def _apply_wave_splits(state: GrowState, new_hist: jnp.ndarray,
     #      (missing_code, num_bins, default_bin) at split time — the
     #      reference's NumericalDecision missing handling (tree.h:218)
     #   3: right-child leaf   4: default_left   5: is_cat
+    # Native bundle-space routing (route_bundle set) appends the winning
+    # feature's bundle coordinates — resolved here for the <= wave_size
+    # chosen splits only, never per row (the reference translates a
+    # FeatureGroup threshold back the same way):
+    #   6: bundled column   7: lo   8: hi   9: off   10: default bin
     sf = cand.feature[p]
     sf_safe = jnp.maximum(sf, 0)
     mc_s, nb_s, db_s = (missing_code[sf_safe], num_bins[sf_safe],
                         default_bin[sf_safe])
     miss_bin = jnp.where(mc_s == 2, nb_s - 1,
                          jnp.where(mc_s == 1, db_s, -1))
-    table = jnp.zeros((L + 1, 6), jnp.int32).at[:, 0].set(-1).at[:, 2].set(-1)
-    rows = jnp.stack([sf.astype(jnp.int32), cand.threshold[p],
-                      miss_bin.astype(jnp.int32), q.astype(jnp.int32),
-                      cand.default_left[p].astype(jnp.int32),
-                      cand.is_cat[p].astype(jnp.int32)], axis=-1)
+    cols = [sf.astype(jnp.int32), cand.threshold[p],
+            miss_bin.astype(jnp.int32), q.astype(jnp.int32),
+            cand.default_left[p].astype(jnp.int32),
+            cand.is_cat[p].astype(jnp.int32)]
+    scratch = [-1, 0, -1, 0, 0, 0]
+    if route_bundle is not None:
+        cols += [route_bundle.col[sf_safe], route_bundle.lo[sf_safe],
+                 route_bundle.hi[sf_safe], route_bundle.off[sf_safe],
+                 db_s.astype(jnp.int32)]
+        scratch += [0, 0, 0, 0, 0]
+    table = jnp.zeros((L + 1, len(cols)), jnp.int32) \
+        .at[:, 0].set(-1).at[:, 2].set(-1)
+    rows = jnp.stack(cols, axis=-1)
     table = table.at[p].set(rows, mode="drop").at[L].set(
-        jnp.array([-1, 0, -1, 0, 0, 0], jnp.int32))
+        jnp.array(scratch, jnp.int32))
     map_mask = None
     if spec.use_categorical:
         map_mask = jnp.zeros((L + 1, B), bool).at[p].set(cand.cat_mask[p],
@@ -452,7 +503,7 @@ def _route_rows(X: jnp.ndarray, lid: jnp.ndarray, table: jnp.ndarray,
     shard's histogram leg) on exactly these ops. Returns
     ``(leaf_id, f_row, go_left, right_row)``; the trailing three feed the
     resident incremental-partition maintenance (step 8)."""
-    packed = table_lookup(lid, table)                         # [N, 6]
+    packed = table_lookup(lid, table)                         # [N, 6|11]
     f_row = packed[:, 0]
     thr_row = packed[:, 1]
     miss_row = packed[:, 2]
@@ -465,7 +516,27 @@ def _route_rows(X: jnp.ndarray, lid: jnp.ndarray, table: jnp.ndarray,
         f_onehot = f_safe[:, None] == jnp.arange(X.shape[1],
                                                  dtype=jnp.int32)[None, :]
         x_bin = jnp.sum(X.astype(jnp.int32) * f_onehot, axis=1)
+    elif not spec.efb_unpack:
+        # native bundle-space routing: the table carries the split's
+        # bundle coordinates, so the row decision is the bundled code
+        # against the bundle-space range/threshold directly (the
+        # reference's DenseBin::Split min_bin/max_bin compare) — same
+        # one-hot multiply-sum idiom as the unbundled path, over G << F
+        # columns, and ZERO per-row table gathers (the
+        # decode_bundled_bin take_along_axis this path deletes was the
+        # routing half of the round-5 3.5x EFB loss)
+        col_row = packed[:, 6]
+        lo_row = packed[:, 7]
+        hi_row = packed[:, 8]
+        off_row = packed[:, 9]
+        db_row = packed[:, 10]
+        g_onehot = col_row[:, None] == jnp.arange(X.shape[1],
+                                                  dtype=jnp.int32)[None, :]
+        c = jnp.sum(X.astype(jnp.int32) * g_onehot, axis=1)
+        in_rng = (c >= lo_row) & (c < hi_row)
+        x_bin = jnp.where(in_rng, c - off_row, db_row)
     else:
+        # legacy arm (tpu_efb_unpack=true): per-row decode gather
         x_bin = decode_bundled_bin(X, f_safe, bundle, default_bin)
     go_left = jnp.where(x_bin == miss_row, dl_row, x_bin <= thr_row)
     if spec.use_categorical:
@@ -501,10 +572,12 @@ def grow_tree(
     globally synced — the tree arrays stay replicated on every device.
 
     With ``bundle`` (EFB, efb.py), ``X`` holds bundled columns: histograms
-    build + cache in bundle space ([.., G, hist_bins, ..]), get unpacked to
-    original feature space before the split scan, and row routing decodes
-    the original bin from the bundled code. Tree arrays are ALWAYS in
-    original feature space.
+    build + cache in bundle space ([.., G, hist_bins, ..]) and — on the
+    native default — the split scan runs on bundle space directly, with
+    only the winning splits translated back and row routing comparing the
+    bundled code against the split's bundle range (spec.efb_unpack keeps
+    the legacy unpack-before-scan arm). Tree arrays are ALWAYS in original
+    feature space.
     """
     if comm is None:
         from .parallel.comm import SerialComm
@@ -521,25 +594,25 @@ def grow_tree(
     # data_parallel_tree_learner.cpp:148-163) — the per-leaf cache, sibling
     # subtraction, and split scan all live in that post-reduction space.
     #
-    # Distributed EFB: the histogram BUILD runs in bundle space ([G, Bb]
-    # one-hot matmul columns — the compute win), but the collective and
-    # everything after it run in ORIGINAL feature space: bundled histograms
-    # are unpacked locally right before comm.reduce_hist using this shard's
-    # leaf totals, so feature blocks stay contiguous and the downstream scan
-    # is unchanged. (Bundle-space reduction would hand each device a block
-    # of bundles whose member features are non-contiguous.)
-    # ...EXCEPT feature-parallel-over-bundles (FeatureParallelBundledComm):
-    # there the bundle block IS the partition unit, rows are replicated (so
-    # local leaf sums are global and the scan-time FixHistogram subtraction
-    # stays exact), and hist/cache stay in bundle-block space — only the
-    # scan unpacks, with a device-localized column map.
-    unbundle_early = (bundle is not None
+    # EFB (native default): bundle space is the representation END-TO-END —
+    # the histogram builds, caches, reduces, and SCANS as [.., G, Bb, ..]
+    # (ops/split_finder.per_feature_best_bundled finds splits on bundled
+    # bins directly, like the reference's FeatureGroup), and only the
+    # <= wave_size winning splits translate back to (feature, bin). Under
+    # data-parallel the psum_scatter therefore runs over bundle-COLUMN
+    # blocks (DataParallelBundledComm — the collective shrinks from F*B to
+    # G*Bb wide) and the scan localizes its code tables to the block.
+    #
+    # LEGACY arm (spec.efb_unpack, the A/B + parity pin): the scan unpacks
+    # to original feature space — serial/bundled-block layouts at scan
+    # time, row-sharded strategies BEFORE the collective using this shard's
+    # leaf totals (feature blocks stay contiguous through the psum_scatter).
+    unbundle_early = (bundle is not None and spec.efb_unpack
                       and getattr(comm, "axis", None) is not None
                       and not getattr(comm, "bundled_blocks", False))
     scan_bundle = bundle
     if bundle is not None and getattr(comm, "bundled_blocks", False):
-        scan_bundle = bundle._replace(
-            col=comm.localize_bundle_col(bundle.col))
+        scan_bundle = comm.localize_bundle(bundle)
     B_hist = spec.hist_bins or B  # bundle-space bin axis (build side)
     if unbundle_early:
         F_cache = comm.reduced_hist_features(spec.num_features)
@@ -715,7 +788,9 @@ def grow_tree(
         state2, table, map_mask, p, q, _n_apply = _apply_wave_splits(
             state, new_hist, leaf_of_slot, bm, spec, comm,
             scan_bundle if (bundle is not None and not unbundle_early)
-            else None, num_bins, missing_code, default_bin)
+            else None, num_bins, missing_code, default_bin,
+            route_bundle=(bundle if (bundle is not None
+                                     and not spec.efb_unpack) else None))
 
         # ---- 7. route rows of split leaves ---------------------------------
         leaf_id, f_row, go_left, right_row = _route_rows(
@@ -869,13 +944,15 @@ class StreamedGrower:
         if comm is None:
             from .parallel.comm import SerialComm
             self.comm = SerialComm(spec.num_features)
-        # EFB placement mirrors grow_tree: row-sharded strategies unpack
-        # BEFORE the collective, serial unpacks at scan time
-        self.unbundle_early = (bundle is not None
+        # EFB placement mirrors grow_tree: the native default scans bundle
+        # space end-to-end (data-parallel reduces bundle-column blocks);
+        # the legacy unpack arm (spec.efb_unpack) unpacks BEFORE the
+        # collective under row-sharded strategies, at scan time serially
+        self.unbundle_early = (bundle is not None and spec.efb_unpack
                                and getattr(self.comm, "axis", None) is not None
                                and not getattr(self.comm, "bundled_blocks",
                                                False))
-        assert not getattr(self.comm, "bundled_blocks", False), \
+        assert pctx is None or pctx.strategy != "feature", \
             "streamed growth does not run under feature-parallel bundling"
         self._mesh = pctx.mesh if pctx is not None else None
         self._n_dev = pctx.num_devices if self._mesh is not None else 1
@@ -949,8 +1026,15 @@ class StreamedGrower:
                 done=jnp.asarray(False),
             )
             leaf_id = jnp.zeros(n_local, jnp.int32)
-            # wave-1 routing table: every leaf "not split" -> identity route
-            table0 = jnp.zeros((L + 1, 6), jnp.int32) \
+            # wave-1 routing table: every leaf "not split" -> identity
+            # route. Width must match what _apply_wave_splits emits for
+            # THIS arm (11 columns with native bundle-space routing) —
+            # a narrower wave-1 table would both re-trace shard_fn/
+            # route_fn against the streamed shape-stability contract and
+            # lean on JAX's silent out-of-bounds clamp for columns 6-10
+            n_route_cols = 11 if (bundle is not None
+                                  and not spec.efb_unpack) else 6
+            table0 = jnp.zeros((L + 1, n_route_cols), jnp.int32) \
                 .at[:, 0].set(-1).at[:, 2].set(-1)
             map_mask0 = (jnp.zeros((L + 1, B), bool)
                          if spec.use_categorical else None)
@@ -1030,11 +1114,16 @@ class StreamedGrower:
                 new_hist = _unpack_bundled(new_hist, bundle, lpg, lph, lpc,
                                            self.default_bin)
             new_hist = comm.reduce_hist(new_hist)
-            scan_bundle = bundle if (bundle is not None
-                                     and not self.unbundle_early) else None
+            scan_bundle = None
+            if bundle is not None and not self.unbundle_early:
+                scan_bundle = (comm.localize_bundle(bundle)
+                               if getattr(comm, "bundled_blocks", False)
+                               else bundle)
             state2, table, map_mask, _p, _q, n_apply = _apply_wave_splits(
                 state, new_hist, leaf_of_slot, bm, spec, comm, scan_bundle,
-                self.num_bins, self.missing_code, self.default_bin)
+                self.num_bins, self.missing_code, self.default_bin,
+                route_bundle=(bundle if (bundle is not None
+                                         and not spec.efb_unpack) else None))
             return state2, table, map_mask, state2.done, n_apply
 
         self.wave_fn = self._wrap(

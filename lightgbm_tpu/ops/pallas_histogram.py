@@ -17,6 +17,13 @@ Design (vs the XLA one-hot matmul in ops/histogram.py):
   pending-prefix order by the caller, and chunks past ceil(n_active/R) skip
   their compute via @pl.when — a skipped chunk costs only its (tiny) DMA,
   so the pass needs no dynamic trip count and no scatter.
+- Under EFB the compacted pass's slot layout is BUNDLE-space native: the
+  caller hands bundled columns with `num_bins_padded` = the bundle-bin pad
+  (grower `hist_bins`), so the VMEM accumulator is [S*ch, G*Bb] — smaller
+  than feature space by the bundling win ratio — and the packed row bytes
+  carry bundle codes. The kernel never sees original-feature space; the
+  bundle-space split scan (ops/split_finder.per_feature_best_bundled)
+  consumes its output as-is, so no unpack sits between kernel and scan.
 
 Precision matches ops/histogram.py: bf16 hi+lo gradient/hessian channels
 accumulated in f32 (~f32-exact; the reference GPU path used plain f32 and

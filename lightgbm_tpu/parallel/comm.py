@@ -46,7 +46,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.categorical import per_feature_best_categorical
 from ..ops.split_finder import (PerFeatureBest, SplitCandidates,
-                                per_feature_best_numerical, reduce_features)
+                                per_feature_best_bundled,
+                                per_feature_best_numerical, reduce_features,
+                                unpack_bundled_hist)
 
 
 def _shard_map(fn, *, mesh, in_specs, out_specs):
@@ -87,11 +89,45 @@ class BlockMeta(NamedTuple):
     offset: jnp.ndarray
 
 
-def block_per_feature(hist, pg, ph, pc, bm: BlockMeta, spec):
+def block_per_feature(hist, pg, ph, pc, bm: BlockMeta, spec, bundle=None):
     """Best split per (slot, feature) over this block: numerical scan for
     non-categorical features, categorical one-hot/sorted-prefix for the rest
     (reference FindBestThreshold dispatch, feature_histogram.hpp:72-104).
-    Returns (PerFeatureBest, cat_mask [S, F, B] or None)."""
+    Returns (PerFeatureBest, cat_mask [S, F, B] or None).
+
+    With ``bundle`` (grower.BundleDecode — the native EFB arm) ``hist`` is
+    BUNDLE-space [S, G, Bb, 3] and the numerical scan runs on it directly
+    (per_feature_best_bundled, the reference's FeatureGroup discipline);
+    categorical features keep the feature-space sorted-prefix search, fed
+    by an unpack RESTRICTED to the categorical members' bundle columns
+    (``spec.cat_features``, static at setup — the cat scan is per-feature
+    independent, so the subset values are bit-identical to a full unpack
+    without re-paying the [T, F, B, 3] decode the redesign deleted).
+    """
+    if bundle is not None:
+        pf = per_feature_best_bundled(
+            hist, pg, ph, pc, bm.num_bins, bm.missing_code, bm.default_bin,
+            bm.feature_ok & ~bm.is_cat, bundle.col, bundle.lo, bundle.hi,
+            bundle.off, bundle.code_feat, **spec.hyperparams())
+        if not spec.use_categorical or not spec.cat_features:
+            return pf, None
+        ci = jnp.asarray(spec.cat_features, jnp.int32)
+        hist_c = unpack_bundled_hist(
+            hist, bundle.col[ci], bundle.unpack_bin[ci],
+            pg, ph, pc, bm.default_bin[ci])             # [T, Fc, B, 3]
+        pf_cat, mask_c = per_feature_best_categorical(
+            hist_c, pg, ph, pc, bm.num_bins[ci], bm.missing_code[ci],
+            (bm.feature_ok & bm.is_cat)[ci], **spec.hyperparams(),
+            **spec.cat_hyperparams())
+        # scatter the cat subset back into full feature width (cat_idx
+        # positions ARE the is_cat positions, so this equals the full-width
+        # where(is_cat, cat, numerical) merge bit-for-bit)
+        merged = PerFeatureBest(*[
+            nv.at[:, ci].set(cv) for nv, cv in zip(pf, pf_cat)])
+        T, B = hist.shape[0], spec.num_bins_padded
+        F = bm.num_bins.shape[0]
+        mask = jnp.zeros((T, F, B), bool).at[:, ci].set(mask_c)
+        return merged, mask
     pf = per_feature_best_numerical(
         hist, pg, ph, pc, bm.num_bins, bm.missing_code, bm.default_bin,
         bm.feature_ok & ~bm.is_cat, **spec.hyperparams())
@@ -106,11 +142,15 @@ def block_per_feature(hist, pg, ph, pc, bm: BlockMeta, spec):
     return merged, mask
 
 
-def find_block_splits(hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
+def find_block_splits(hist, pg, ph, pc, bm: BlockMeta, spec,
+                      bundle=None) -> SplitCandidates:
     """Best split per slot over this block's features (feature argmax)."""
-    pf, mask = block_per_feature(hist, pg, ph, pc, bm, spec)
+    pf, mask = block_per_feature(hist, pg, ph, pc, bm, spec, bundle)
+    # candidate cat_mask stays ORIGINAL-bin-space wide even when the scan
+    # ran on bundle space (the [L+1, B] routing mask consumes it)
+    nb_pad = spec.num_bins_padded if bundle is not None else hist.shape[2]
     if mask is None:
-        return reduce_features(pf, bm.offset, num_bins_padded=hist.shape[2])
+        return reduce_features(pf, bm.offset, num_bins_padded=nb_pad)
     return reduce_features(pf, bm.offset, is_cat=bm.is_cat, cat_mask=mask)
 
 
@@ -171,15 +211,20 @@ class SerialComm:
         return BlockMeta(feature_ok, num_bins, missing_code, default_bin,
                          is_cat, jnp.asarray(0, jnp.int32))
 
-    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
-        return find_block_splits(hist, pg, ph, pc, bm, spec)
+    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec,
+                    bundle=None) -> SplitCandidates:
+        return find_block_splits(hist, pg, ph, pc, bm, spec, bundle)
 
     def collective_bytes(self, num_slots: int, num_bins_padded: int,
-                         use_categorical: bool = True) -> dict:
+                         use_categorical: bool = True,
+                         hist_bins: int = None) -> dict:
         """Per-wave collective payload estimate in bytes, by collective —
         the MULTICHIP cost story (observability/costs.py publishes these as
-        ``comm.bytes_per_wave.*`` gauges at booster construction). Serial
-        runs no collectives."""
+        ``comm.bytes_per_wave.*`` gauges at booster construction).
+        ``hist_bins`` is the bin width of the histograms the wave actually
+        moves: bundle space (Bb) on the native EFB arm, original feature
+        space otherwise — charging feature-space widths for a bundled run
+        overstated every histogram collective. Serial runs none."""
         return {}
 
 
@@ -230,16 +275,22 @@ class DataParallelComm:
             _block_slice(missing_code, i, b), _block_slice(default_bin, i, b),
             _block_slice(is_cat, i, b), i * b)
 
-    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
-        return _gather_argmax(find_block_splits(hist, pg, ph, pc, bm, spec),
-                              self.axis)
+    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec,
+                    bundle=None) -> SplitCandidates:
+        return _gather_argmax(find_block_splits(hist, pg, ph, pc, bm, spec,
+                                                bundle), self.axis)
 
     def collective_bytes(self, num_slots: int, num_bins_padded: int,
-                         use_categorical: bool = True) -> dict:
+                         use_categorical: bool = True,
+                         hist_bins: int = None) -> dict:
         """Data-parallel pays the full-width histogram reduce-scatter every
         wave (the reference's ReduceScatter of HistogramBinEntry,
         data_parallel_tree_learner.cpp:148-163) plus the candidate
-        all-gather and one 3-scalar root psum per tree.
+        all-gather and one 3-scalar root psum per tree. This class only
+        serves UNBUNDLED (or legacy early-unpacked EFB) runs, so the
+        reduce-scatter is feature-space wide by construction; the native
+        bundled run's shrunken collective lives on
+        DataParallelBundledComm.
 
         The reduce-scatter covers the ``num_slots`` freshly-built
         histograms (siblings derive locally by subtraction); the candidate
@@ -284,7 +335,8 @@ class FeatureParallelComm:
     find_splits = DataParallelComm.find_splits
 
     def collective_bytes(self, num_slots: int, num_bins_padded: int,
-                         use_categorical: bool = True) -> dict:
+                         use_categorical: bool = True,
+                         hist_bins: int = None) -> dict:
         """Feature-parallel never moves histograms — rows are replicated,
         so the only wave collective is the candidate all-gather (over the
         2*num_slots slot+sibling scan rows, like DataParallelComm)."""
@@ -346,23 +398,114 @@ class FeatureParallelBundledComm:
         return BlockMeta(feature_ok & owned, num_bins, missing_code,
                          default_bin, is_cat, jnp.asarray(0, jnp.int32))
 
-    def localize_bundle_col(self, col):
-        """Global [F] bundle-column map -> this device's block-local map
-        (clipped; non-owned features are masked off by ``block_meta``)."""
+    def localize_bundle(self, bundle):
+        """Global bundle tables -> this device's block-local view: the
+        [F] column map shifted into the block (clipped; non-owned features
+        are masked off by ``block_meta``) and the [G, Bb] code-owner table
+        sliced to the owned columns (the native scan is driven by it)."""
         i = jax.lax.axis_index(self.axis)
-        return jnp.clip(col - i * self.block, 0, self.block - 1)
+        return bundle._replace(
+            col=jnp.clip(bundle.col - i * self.block, 0, self.block - 1),
+            code_feat=jax.lax.dynamic_slice_in_dim(
+                bundle.code_feat, i * self.block, self.block, axis=0))
 
-    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
-        return _gather_argmax(find_block_splits(hist, pg, ph, pc, bm, spec),
-                              self.axis)
+    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec,
+                    bundle=None) -> SplitCandidates:
+        return _gather_argmax(find_block_splits(hist, pg, ph, pc, bm, spec,
+                                                bundle), self.axis)
 
     def collective_bytes(self, num_slots: int, num_bins_padded: int,
-                         use_categorical: bool = True) -> dict:
+                         use_categorical: bool = True,
+                         hist_bins: int = None) -> dict:
         """Bundled feature-parallel: bundles are the partition unit but the
         wave collective is still only the candidate all-gather (2*num_slots
         slot+sibling scan rows)."""
         return {
             "allgather_splits": (self.num_devices * 2 * num_slots
+                                 * _split_candidate_bytes(num_bins_padded,
+                                         use_categorical)),
+        }
+
+
+@dataclass(frozen=True)
+class DataParallelBundledComm:
+    """Data-parallel under the NATIVE EFB scan: rows sharded on ``axis``,
+    the per-wave histogram reduce-scatter runs over BUNDLE-COLUMN blocks.
+
+    The whole point of the bundle-space redesign applied to the collective:
+    the reference's ReduceScatter of HistogramBinEntry moves post-EFB
+    feature-group histograms (its storage unit IS the group), never raw
+    features — here the psum_scatter payload shrinks from ``S * F * B``
+    to ``S * G * Bb`` entries, and each device scans the member features
+    of its own bundle block natively (per_feature_best_bundled with the
+    block-localized code tables). Split candidates carry GLOBAL original
+    feature indices, so the all-gather argmax (SyncUpGlobalBestSplit) is
+    unchanged. The legacy arm (``tpu_efb_unpack=true``) keeps the plain
+    :class:`DataParallelComm` with its unpack-before-collective layout.
+    """
+    axis: str
+    num_devices: int
+    num_features: int                # F_pad: ORIGINAL feature space width
+    num_bundles: int                 # G_pad: divisible by num_devices
+    bundle_col: object               # [F_pad] i32 bundled column of feature f
+
+    # grower: hist/cache stay in per-device bundle blocks; the scan runs
+    # natively on the block with localized code tables
+    bundled_blocks = True
+
+    @property
+    def block(self) -> int:
+        return self.num_bundles // self.num_devices
+
+    def reduce_scalars(self, *xs):
+        return tuple(jax.lax.psum(x, self.axis) for x in xs)
+
+    def hist_X(self, X):
+        return X                      # all bundled columns, local rows
+
+    def reduce_hist(self, hist):
+        # [S, G, Bb, 3] local sums -> [S, G/D, Bb, 3] global sums of my
+        # bundle block (the F*B -> G*Bb collective shrink)
+        S, G, B, C = hist.shape
+        D = self.num_devices
+        blocks = hist.reshape(S, D, self.block, B, C)
+        blocks = jnp.moveaxis(blocks, 1, 0)           # [D, S, G/D, B, C]
+        return jax.lax.psum_scatter(blocks, self.axis, scatter_dimension=0,
+                                    tiled=False)
+
+    def reduced_hist_features(self, F_hist: int) -> int:
+        return self.block
+
+    def block_meta(self, feature_ok, num_bins, missing_code, default_bin,
+                   is_cat) -> BlockMeta:
+        # full-width ORIGINAL-feature metadata, masked to the member
+        # features of this device's bundle block (candidates stay global)
+        i = jax.lax.axis_index(self.axis)
+        owned = jnp.asarray(self.bundle_col) // self.block == i
+        return BlockMeta(feature_ok & owned, num_bins, missing_code,
+                         default_bin, is_cat, jnp.asarray(0, jnp.int32))
+
+    localize_bundle = FeatureParallelBundledComm.localize_bundle
+
+    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec,
+                    bundle=None) -> SplitCandidates:
+        return _gather_argmax(find_block_splits(hist, pg, ph, pc, bm, spec,
+                                                bundle), self.axis)
+
+    def collective_bytes(self, num_slots: int, num_bins_padded: int,
+                         use_categorical: bool = True,
+                         hist_bins: int = None) -> dict:
+        """Like DataParallelComm but the histogram reduce-scatter is
+        BUNDLE-space wide: ``num_bundles * hist_bins`` columns instead of
+        ``num_features * num_bins_padded`` — the analytic half of the
+        collective shrink, validated against the compiled HLO
+        (tests/test_multichip_parity.py)."""
+        scan_slots = 2 * num_slots
+        return {
+            "psum_root_scalars": 3 * 4,
+            "psum_scatter_hist": (num_slots * self.num_bundles
+                                  * (hist_bins or num_bins_padded) * 3 * 4),
+            "allgather_splits": (self.num_devices * scan_slots
                                  * _split_candidate_bytes(num_bins_padded,
                                          use_categorical)),
         }
@@ -392,10 +535,13 @@ class VotingParallelComm:
         return BlockMeta(feature_ok, num_bins, missing_code, default_bin,
                          is_cat, jnp.asarray(0, jnp.int32))
 
-    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec) -> SplitCandidates:
+    def find_splits(self, hist, pg, ph, pc, bm: BlockMeta, spec,
+                    bundle=None) -> SplitCandidates:
         import dataclasses
 
-        S, F, B, C = hist.shape
+        S = hist.shape[0]
+        F = self.num_features
+        B = hist.shape[2]
         k = max(1, min(self.top_k, F))
         k2 = min(2 * k, F)
 
@@ -414,7 +560,7 @@ class VotingParallelComm:
             min_sum_hessian_in_leaf=(spec.min_sum_hessian_in_leaf
                                      / self.num_devices))
         pf_local, _ = block_per_feature(hist, local_pg, local_ph, local_pc,
-                                        bm, local_spec)
+                                        bm, local_spec, bundle)
         local_gain = pf_local.gain
         top_gain, top_feat = jax.lax.top_k(local_gain, k)           # [S, k]
         votes = jnp.zeros((S, F), jnp.float32).at[
@@ -437,35 +583,73 @@ class VotingParallelComm:
                 jnp.arange(F, dtype=jnp.int32)[None, :])
         rank_score = votes.astype(jnp.int32) * F + gain_rank
         _, sel = jax.lax.top_k(rank_score, k2)                      # [S, k2] global ids
-        sel_hist = jnp.take_along_axis(
-            hist, sel[:, :, None, None], axis=1)                    # [S, k2, B, 3]
-        sel_hist = jax.lax.psum(sel_hist, self.axis)
+        if bundle is not None:
+            # native EFB: reduce only the winning features' BUNDLE columns
+            # — the psum payload is [S, k2, Bb, 3] instead of feature-space
+            # [S, k2, B, 3] — and scan each selected member natively on its
+            # gathered column (a per-slot one-member bundle view; the
+            # default-bin hole at off+db stays unowned so the FixHistogram
+            # deficit reconstructs it exactly like the global scan)
+            Bb = hist.shape[2]
+            sel_col = jnp.asarray(bundle.col)[sel]                  # [S, k2]
+            sel_hist = jnp.take_along_axis(
+                hist, sel_col[:, :, None, None], axis=1)            # [S,k2,Bb,3]
+            sel_hist = jax.lax.psum(sel_hist, self.axis)
+            iota_c = jnp.arange(Bb, dtype=jnp.int32)
+            jidx = jnp.arange(k2, dtype=jnp.int32)
 
-        # Per-slot feature metadata: vmap the scan over slots since each slot
-        # selected different features.
-        def scan_slot(h_slot, sel_slot, pg_, ph_, pc_):
-            bm_slot = BlockMeta(
-                bm.feature_ok[sel_slot], bm.num_bins[sel_slot],
-                bm.missing_code[sel_slot], bm.default_bin[sel_slot],
-                bm.is_cat[sel_slot], jnp.asarray(0, jnp.int32))
-            cand = find_block_splits(h_slot[None], pg_[None], ph_[None],
-                                     pc_[None], bm_slot, spec)
-            return jax.tree.map(lambda a: a[0], cand)
+            def scan_slot_b(h_slot, lo_, hi_, off_, nb_, mc_, db_, ok_,
+                            pg_, ph_, pc_):
+                owned = ((iota_c[None, :] >= lo_[:, None])
+                         & (iota_c[None, :] < hi_[:, None])
+                         & (iota_c[None, :] != (off_ + db_)[:, None]))
+                cf = jnp.where(owned, jidx[:, None], -1)
+                pf = per_feature_best_bundled(
+                    h_slot[None], pg_[None], ph_[None], pc_[None],
+                    nb_, mc_, db_, ok_, jidx, lo_, hi_, off_, cf,
+                    **spec.hyperparams())
+                cand = reduce_features(pf,
+                                       num_bins_padded=spec.num_bins_padded)
+                return jax.tree.map(lambda a: a[0], cand)
 
-        cand = jax.vmap(scan_slot)(sel_hist, sel, pg, ph, pc)
+            cand = jax.vmap(scan_slot_b)(
+                sel_hist, bundle.lo[sel], bundle.hi[sel], bundle.off[sel],
+                bm.num_bins[sel], bm.missing_code[sel], bm.default_bin[sel],
+                bm.feature_ok[sel] & ~bm.is_cat[sel], pg, ph, pc)
+        else:
+            sel_hist = jnp.take_along_axis(
+                hist, sel[:, :, None, None], axis=1)                # [S, k2, B, 3]
+            sel_hist = jax.lax.psum(sel_hist, self.axis)
+
+            # Per-slot feature metadata: vmap the scan over slots since
+            # each slot selected different features.
+            def scan_slot(h_slot, sel_slot, pg_, ph_, pc_):
+                bm_slot = BlockMeta(
+                    bm.feature_ok[sel_slot], bm.num_bins[sel_slot],
+                    bm.missing_code[sel_slot], bm.default_bin[sel_slot],
+                    bm.is_cat[sel_slot], jnp.asarray(0, jnp.int32))
+                cand = find_block_splits(h_slot[None], pg_[None], ph_[None],
+                                         pc_[None], bm_slot, spec)
+                return jax.tree.map(lambda a: a[0], cand)
+
+            cand = jax.vmap(scan_slot)(sel_hist, sel, pg, ph, pc)
         # map local candidate index -> global feature id
         feat = jnp.take_along_axis(sel, cand.feature[:, None], axis=1)[:, 0]
         return cand._replace(feature=feat.astype(jnp.int32))
 
     def collective_bytes(self, num_slots: int, num_bins_padded: int,
-                         use_categorical: bool = True) -> dict:
+                         use_categorical: bool = True,
+                         hist_bins: int = None) -> dict:
         """PV-Tree's O(k/F) trade made explicit: votes + gain ranks are
         [S, F] f32 psums, and only the ~2k winning features' histogram
         columns reduce (CopyLocalHistogram,
         voting_parallel_tree_learner.cpp:197) — compare psum_selected_hist
         here against DataParallelComm's full psum_scatter_hist. Every one
         of these runs inside ``find_splits``, whose slot axis is the
-        2*num_slots slot+sibling scan (grower.py step 4)."""
+        2*num_slots slot+sibling scan (grower.py step 4). Under the native
+        EFB arm the selected columns are BUNDLE columns, so their psum is
+        ``hist_bins`` (Bb) wide — the bundled-run fix for an estimate that
+        used to charge feature-space widths regardless."""
         F = self.num_features
         k2 = min(2 * max(1, min(self.top_k, F)), F)
         scan_slots = 2 * num_slots
@@ -473,7 +657,8 @@ class VotingParallelComm:
             "psum_root_scalars": 3 * 4,
             "psum_votes": scan_slots * F * 4,
             "psum_gain_ranks": scan_slots * F * 4,
-            "psum_selected_hist": scan_slots * k2 * num_bins_padded * 3 * 4,
+            "psum_selected_hist": (scan_slots * k2
+                                   * (hist_bins or num_bins_padded) * 3 * 4),
             "allgather_splits": (self.num_devices * scan_slots
                                  * _split_candidate_bytes(num_bins_padded,
                                          use_categorical)),
@@ -588,7 +773,18 @@ class ParallelContext:
 
     def make_comm(self, num_features: int, num_bundles: int = 0,
                   bundle_col=None):
+        """``num_bundles > 0`` selects the bundle-partitioned comm for the
+        block strategies: always for feature-parallel (bundles ARE the
+        partition unit there, both EFB arms), and for data-parallel only on
+        the native bundle-space arm (the legacy unpack arm reduces
+        feature-space histograms through the plain DataParallelComm).
+        Voting needs no bundled twin — its ``find_splits`` branches on the
+        per-call ``bundle`` tables."""
         if self.strategy == "data":
+            if num_bundles:
+                return DataParallelBundledComm(
+                    self.ROW_AXIS, self.num_devices, num_features,
+                    num_bundles, bundle_col)
             return DataParallelComm(self.ROW_AXIS, self.num_devices, num_features)
         if self.strategy == "feature":
             if num_bundles:

@@ -7,6 +7,13 @@ indices, real-valued thresholds (bin upper bounds), decision_type bit packing
 (categorical bit 0, default_left bit 1, missing type bits 2-3 —
 tree.h:184-211), and implements NumericalDecision/CategoricalDecision
 semantics for raw-value prediction (tree.h:218-284) vectorized over rows.
+
+Split records are ALWAYS original-feature space regardless of the training
+representation: under EFB the bundle-space scan translates the winning
+(bundled column, bundle bin) back to (feature, original bin) for the
+<= wave_size chosen splits before they reach TreeArrays (the reference's
+FeatureGroup threshold translation), so nothing here ever sees a bundle
+coordinate and exported models are representation-independent.
 """
 from __future__ import annotations
 

@@ -1,0 +1,426 @@
+"""Bundle-space split finding — the native EFB arm (ISSUE 13 tentpole).
+
+Pins the redesign's contracts (ops/split_finder.per_feature_best_bundled,
+grower bundle-space routing, DataParallelBundledComm, the voting
+selected-column psum):
+
+- BIT-identity (model text equality) of the three arms — native
+  bundle-space scan vs the legacy ``tpu_efb_unpack=true`` unpack arm vs
+  ``enable_bundle=false`` — on exact-arithmetic data (a quantized-residual
+  custom objective keeps every histogram sum exactly representable in f32,
+  so any summation order yields identical floats; on arbitrary float data
+  the arms differ only in last-ulp cumsum association, pinned separately
+  as structural equality);
+- the identity holds across serial / 8-device data-parallel / streamed
+  residency, u4 bit-packed codes, voting + feature-parallel, a
+  categorical+bundled mix, and the fused ``tree_batch=4`` path including
+  a mid-batch checkpoint resume;
+- a PLANTED gain tie across a bundle-member boundary resolves to the
+  lowest original feature index in every arm (the feature-space flat
+  argmax tie-break the bundled scan replicates);
+- the native routing pass contains NO gather primitive at all — the
+  per-row ``decode_bundled_bin`` take_along_axis (the routing half of the
+  round-5 3.5x loss) exists only on the legacy arm;
+- config surface: enable_bundle tri-state normalization,
+  max_conflict_rate in [0, 1), tpu_efb_unpack + enable_bundle=false
+  rejected loudly;
+- bundle-space collective-byte estimates (parallel/comm.py).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+# ------------------------------------------------------------ data builders
+
+def _mixed_sparse(n=1500, dense=4, flag_groups=3, flags_per_group=20, seed=3):
+    """Few dense features + mutually-exclusive binary flag groups (the
+    one-hot regime EFB exists for; zero conflicts)."""
+    rng = np.random.RandomState(seed)
+    Xd = rng.rand(n, dense)
+    flags = np.zeros((n, flag_groups * flags_per_group))
+    picks = rng.randint(0, flags_per_group, size=(n, flag_groups))
+    for g in range(flag_groups):
+        flags[np.arange(n), g * flags_per_group + picks[:, g]] = 1.0
+    X = np.concatenate([Xd, flags], axis=1)
+    y = (Xd[:, 0] + 0.3 * (picks[:, 0] > flags_per_group // 2)
+         + 0.1 * rng.randn(n) > 0.65).astype(np.float64)
+    return X, y
+
+
+def _u4_sparse(n=1200, flag_groups=6, flags_per_group=7, seed=5):
+    """All-flag dataset whose bundles stay under 16 codes (7 members + the
+    all-default code 0) so the packed-row layout resolves to u4."""
+    rng = np.random.RandomState(seed)
+    flags = np.zeros((n, flag_groups * flags_per_group))
+    picks = rng.randint(0, flags_per_group, size=(n, flag_groups))
+    for g in range(flag_groups):
+        flags[np.arange(n), g * flags_per_group + picks[:, g]] = 1.0
+    y = ((picks[:, 0] + picks[:, 1]) % 3 == 0).astype(np.float64)
+    return flags, y
+
+
+def _exact_fobj(preds, ds):
+    """Quantized-residual gradients: multiples of 1/64 with |g| <= ~2, so
+    f32 sums over thousands of rows are EXACT under any association —
+    the bit-identity driver for cross-arm model-text equality."""
+    y = ds.get_label()
+    g = np.clip(np.round((preds - y) * 64) / 64.0, -2.0, 2.0)
+    return g.astype(np.float64), np.ones_like(g)
+
+
+BASE = dict(objective="regression", boost_from_average=False, num_leaves=15,
+            min_data_in_leaf=5, learning_rate=0.5, device="cpu", verbose=-1,
+            metric="none")
+
+
+def _train(X, y, rounds=8, fobj=_exact_fobj, **extra):
+    params = dict(BASE, **extra)
+    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds,
+                     fobj=fobj, keep_training_booster=True,
+                     verbose_eval=False)
+
+
+def _text(bst):
+    return bst.model_to_string()
+
+
+# --------------------------------------------------- trio bit-identity axes
+
+def test_serial_trio_bit_identity():
+    """Native bundle-space == legacy unpack == EFB-off, model text equal,
+    on exact-arithmetic data (serial)."""
+    X, y = _mixed_sparse()
+    b_nat = _train(X, y)
+    assert b_nat._gbdt.bundle is not None, "EFB should engage"
+    assert not b_nat._gbdt.spec.efb_unpack
+    b_unp = _train(X, y, tpu_efb_unpack=True)
+    assert b_unp._gbdt.spec.efb_unpack
+    b_off = _train(X, y, enable_bundle=False)
+    assert b_off._gbdt.bundle is None
+    s = _text(b_nat)
+    assert s == _text(b_unp)
+    assert s == _text(b_off)
+
+
+# tier-1 wall-clock split (the PR-12 discipline): data-parallel is the fast
+# representative of the distributed axis; voting/feature + stream +
+# tree_batch + categorical ride `make check` / `make chaos`-style full runs
+@pytest.mark.parametrize("learner", [
+    "data",
+    pytest.param("voting", marks=pytest.mark.slow),
+    pytest.param("feature", marks=pytest.mark.slow),
+])
+def test_distributed_bit_identity(learner):
+    """Each distributed strategy's native arm matches its own legacy-unpack
+    arm bit-exactly; the row/bundle-sharded strategies additionally match
+    the serial native model (voting is approximate BY DESIGN vs serial —
+    PV-Tree's top-k vote can pick different candidates — so only its
+    arm-vs-arm identity is pinned)."""
+    X, y = _mixed_sparse()
+    b_nat = _train(X, y, tree_learner=learner)
+    b_unp = _train(X, y, tree_learner=learner, tpu_efb_unpack=True)
+    assert b_nat._gbdt.bundle is not None
+    assert _text(b_nat) == _text(b_unp)
+    if learner == "data":
+        from lightgbm_tpu.parallel.comm import DataParallelBundledComm
+        assert isinstance(b_nat._gbdt.comm, DataParallelBundledComm)
+    if learner != "voting":
+        assert _text(b_nat) == _text(_train(X, y))
+
+
+@pytest.mark.slow
+def test_stream_bit_identity():
+    """Streamed residency on the native arm matches device residency with
+    the stream-equivalent math (tpu_row_compact=false)."""
+    X, y = _mixed_sparse()
+    b_str = _train(X, y, tpu_residency="stream", tpu_hbm_budget_bytes=10**5)
+    assert b_str._gbdt.residency == "stream"
+    assert b_str._gbdt.bundle is not None
+    b_dev = _train(X, y, tpu_row_compact=False)
+    assert _text(b_str) == _text(b_dev)
+
+
+@pytest.mark.slow
+def test_stream_bundled_steady_state_zero_recompiles():
+    """Streamed + native-bundled steady state adds ZERO jit cache misses —
+    in particular the wave-1 inert routing table must already carry the
+    native arm's 11-column width, or shard_pass/route would re-trace on
+    the wave-2 table shape (caught by review; pinned here)."""
+    from lightgbm_tpu.analysis.guards import RecompileGuard
+    X, y = _mixed_sparse(n=1024)
+    p = dict(BASE, objective="binary", tpu_residency="stream",
+             tpu_hbm_budget_bytes=10**5)
+    p.pop("boost_from_average")
+    bst = lgb.Booster(params=p,
+                      train_set=lgb.Dataset(X, label=y, params=p))
+    g = bst._gbdt
+    assert g.residency == "stream" and g.bundle is not None
+    assert not g.spec.efb_unpack
+    for _ in range(2):
+        bst.update()
+    np.asarray(g.score).sum()
+    guard = RecompileGuard(label="efb-stream-test")
+    for name, fn in g._streamed_grower.jit_entrypoints():
+        guard.register(fn, name)
+    with guard:
+        guard.mark_warm()
+        for _ in range(3):
+            bst.update()
+        np.asarray(g.score).sum()
+    assert guard.report()["post_warmup_cache_misses"] == 0, guard.report()
+
+
+def test_u4_code_mode_bit_identity():
+    """u4 bit-packed bundle codes (< 16 bundle bins) keep the trio
+    bit-identical — the compacted-pass packed-row layout in bundle space."""
+    X, y = _u4_sparse()
+    b_nat = _train(X, y)
+    assert b_nat._gbdt.bundle is not None
+    assert b_nat._gbdt.spec.code_mode == "u4", b_nat._gbdt.spec.code_mode
+    s = _text(b_nat)
+    assert s == _text(_train(X, y, tpu_efb_unpack=True))
+    assert s == _text(_train(X, y, enable_bundle=False))
+
+
+@pytest.mark.slow
+def test_tree_batch_fused_bit_identity(tmp_path):
+    """tree_batch=4 through the fused scan is bit-identical to per-tree
+    dispatch on the native bundle-space arm, including a MID-BATCH
+    checkpoint resume (interrupt at an iteration that is not a batch
+    multiple)."""
+    X, y = _mixed_sparse()
+    params = dict(BASE, objective="binary", metric="none")
+    del params["boost_from_average"]
+    b1 = lgb.train(dict(params, tree_batch=1), lgb.Dataset(X, label=y),
+                   num_boost_round=12, keep_training_booster=True)
+    assert b1._gbdt.bundle is not None
+    b4 = lgb.train(dict(params, tree_batch=4), lgb.Dataset(X, label=y),
+                   num_boost_round=12, keep_training_booster=True)
+    assert _text(b1) == _text(b4)
+    # mid-batch resume: checkpoints every 3 iterations under tree_batch=4,
+    # interrupted at 6 — neither lands on a 4-batch boundary
+    ck = str(tmp_path / "ck")
+    ckp = dict(params, tree_batch=4, checkpoint_dir=ck, checkpoint_interval=3)
+    lgb.train(dict(ckp), lgb.Dataset(X, label=y), num_boost_round=6)
+    resumed = lgb.train(dict(ckp, resume_from="auto"),
+                        lgb.Dataset(X, label=y), num_boost_round=12,
+                        keep_training_booster=True)
+    assert _text(b4) == _text(resumed)
+
+
+@pytest.mark.slow
+def test_categorical_bundled_mix_bit_identity():
+    """Categorical + bundled numerical features: the native arm keeps the
+    feature-space sorted-prefix scan for categoricals (fed by a cat-only
+    unpack) and the bundle-space scan for numericals — bit-identical to
+    the legacy arm, with categorical splits actually present."""
+    X, y = _mixed_sparse(n=1200)
+    rng = np.random.RandomState(9)
+    cat = rng.randint(0, 6, size=X.shape[0]).astype(np.float64)
+    y = np.where(cat >= 4, 1.0 - y, y)        # make the categorical matter
+    X = np.column_stack([X, cat])
+    cat_col = X.shape[1] - 1
+
+    def train_cat(**extra):
+        params = dict(BASE, min_data_per_group=5, **extra)
+        return lgb.train(params,
+                         lgb.Dataset(X, label=y,
+                                     categorical_feature=[cat_col]),
+                         num_boost_round=8, fobj=_exact_fobj,
+                         keep_training_booster=True, verbose_eval=False)
+
+    b_nat = train_cat()
+    assert b_nat._gbdt.bundle is not None
+    assert b_nat._gbdt.spec.use_categorical
+    s = _text(b_nat)
+    assert s == _text(train_cat(tpu_efb_unpack=True))
+    assert s == _text(train_cat(enable_bundle=False))
+    assert any(t.cat_boundaries is not None for t in b_nat.trees), \
+        "expected at least one categorical split in the pinned model"
+
+
+# ----------------------------------------------------- planted tie-break pin
+
+def test_planted_tie_on_bundle_member_boundary():
+    """Two members of ONE bundle with exactly identical histograms: the
+    split gains tie bit-exactly (dyadic gradients), and every arm must
+    resolve the tie to the LOWEST original feature index — the
+    feature-space argmax rule the bundled scan's min-threshold /
+    min-feature scatter reduction replicates across the member boundary."""
+    n = 640
+    X = np.zeros((n, 3))
+    X[0:160, 0] = 1.0          # member A rows
+    X[160:320, 1] = 1.0        # member B rows — identical histogram to A
+    X[:, 2] = np.arange(n) % 2  # low-signal filler
+    y = np.zeros(n)
+    y[0:80] = 1.0              # A rows: half positive
+    y[160:240] = 1.0           # B rows: half positive (same composition)
+    texts = []
+    for extra in (dict(), dict(tpu_efb_unpack=True),
+                  dict(enable_bundle=False)):
+        bst = _train(X, y, rounds=1, num_leaves=4, min_data_in_leaf=1,
+                     **extra)
+        tree = bst.trees[0]
+        # the tie must break to feature 0 (lowest index), never feature 1
+        assert tree.split_feature[0] == 0, (extra, tree.split_feature)
+        texts.append(_text(bst))
+    assert texts[0] == texts[1] == texts[2]
+    # sanity: A and B really shared a bundle on the EFB arms
+    b = _train(X, y, rounds=1, num_leaves=4, min_data_in_leaf=1)
+    col = np.asarray(b._gbdt.bundle.col)
+    assert col[0] == col[1], "planted members must share one bundle"
+
+
+# ------------------------------------------------- routing jaxpr inspection
+
+def _jaxpr_has_primitive(jaxpr, name: str) -> bool:
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == name:
+            return True
+        for v in eqn.params.values():
+            for j in (v if isinstance(v, (list, tuple)) else [v]):
+                inner = getattr(j, "jaxpr", None)
+                if inner is not None and _jaxpr_has_primitive(inner, name):
+                    return True
+                if hasattr(j, "eqns") and _jaxpr_has_primitive(j, name):
+                    return True
+    return False
+
+
+@pytest.mark.parametrize("efb_unpack,expect_gather", [(False, False),
+                                                      (True, True)])
+def test_routing_jaxpr_gather_presence(efb_unpack, expect_gather):
+    """The native routing pass must contain NO gather primitive at all —
+    the split's bundle coordinates ride the one-hot routing table and the
+    code compare is a one-hot multiply-sum; the legacy arm keeps the
+    per-row decode_bundled_bin take_along_axis (a gather). This is the
+    jaxpr pin that the [F, B] unpack-table gather never returns to the
+    routing hot path."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.grower import BundleDecode, GrowerSpec, _route_rows
+
+    N, G, F, B, Bb = 64, 3, 8, 8, 16
+    spec = GrowerSpec(
+        num_leaves=7, num_features=F, num_bins_padded=B, chunk_rows=32,
+        hist_slots=3, wave_size=3, max_depth=-1, lambda_l1=0.0,
+        lambda_l2=0.0, min_data_in_leaf=1.0, min_sum_hessian_in_leaf=0.0,
+        min_gain_to_split=0.0, efb_unpack=efb_unpack)
+    bundle = BundleDecode(
+        col=jnp.zeros(F, jnp.int32), lo=jnp.ones(F, jnp.int32),
+        hi=jnp.full(F, 2, jnp.int32), off=jnp.zeros(F, jnp.int32),
+        unpack_bin=jnp.zeros((F, B), jnp.int32),
+        code_feat=jnp.zeros((G, Bb), jnp.int32))
+    n_cols = 6 if efb_unpack else 11
+    jx = jax.make_jaxpr(
+        lambda X, lid, table, db: _route_rows(X, lid, table, None, spec,
+                                              bundle, db))(
+        jnp.zeros((N, G), jnp.uint8), jnp.zeros(N, jnp.int32),
+        jnp.zeros((8, n_cols), jnp.int32), jnp.zeros(F, jnp.int32))
+    assert _jaxpr_has_primitive(jx.jaxpr, "gather") == expect_gather
+
+
+# -------------------------------------------------- collective byte estimates
+
+def test_bundled_collective_bytes():
+    from lightgbm_tpu.parallel.comm import (DataParallelBundledComm,
+                                            DataParallelComm,
+                                            VotingParallelComm)
+    S, B, Bb = 4, 256, 64
+    dpb = DataParallelBundledComm("rows", 8, num_features=968,
+                                  num_bundles=128, bundle_col=None)
+    est = dpb.collective_bytes(S, B, use_categorical=False, hist_bins=Bb)
+    # the tentpole's collective shrink: G*Bb, not F*B
+    assert est["psum_scatter_hist"] == S * 128 * Bb * 3 * 4
+    dense = DataParallelComm("rows", 8, 968).collective_bytes(
+        S, B, use_categorical=False)
+    assert est["psum_scatter_hist"] < dense["psum_scatter_hist"] / 10
+    # candidate all-gather stays original-bin-space (cat mask width)
+    assert est["allgather_splits"] == dense["allgather_splits"]
+    vp = VotingParallelComm("rows", 8, 968, top_k=20)
+    sel_b = vp.collective_bytes(S, B, use_categorical=False, hist_bins=Bb)
+    sel_f = vp.collective_bytes(S, B, use_categorical=False)
+    assert sel_b["psum_selected_hist"] * B == sel_f["psum_selected_hist"] * Bb
+    assert sel_b["psum_votes"] == sel_f["psum_votes"]
+
+
+# ------------------------------------------------------------- config surface
+
+def test_config_enable_bundle_tristate():
+    assert Config.from_params({}).enable_bundle == "auto"
+    assert Config.from_params(dict(enable_bundle=True)).enable_bundle == "true"
+    assert Config.from_params(
+        dict(enable_bundle=False)).enable_bundle == "false"
+    assert Config.from_params(
+        dict(enable_bundle="auto")).enable_bundle == "auto"
+    assert Config.from_params(
+        dict(enable_bundle="1")).enable_bundle == "true"
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(enable_bundle="sometimes"))
+
+
+def test_config_max_conflict_rate_validated():
+    assert Config.from_params(
+        dict(max_conflict_rate=0.05)).max_conflict_rate == 0.05
+    assert Config.from_params(
+        dict(max_conflict_rate=0.0)).max_conflict_rate == 0.0
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(max_conflict_rate=1.0))
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(max_conflict_rate=-0.1))
+
+
+def test_config_efb_unpack_requires_bundling():
+    assert Config.from_params(dict(tpu_efb_unpack=True)).tpu_efb_unpack
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(tpu_efb_unpack=True, enable_bundle=False))
+
+
+def test_enable_bundle_auto_resolution():
+    """auto engages bundling exactly when the BundlePlan wins the shape
+    class (the flags regime) and stays off for dense data — the
+    tpu_hist_kernel=auto-style resolution."""
+    X, y = _mixed_sparse(n=600)
+    b_auto = _train(X, y, rounds=1)
+    assert b_auto._gbdt.config.enable_bundle == "auto"
+    assert b_auto._gbdt.bundle is not None
+    rng = np.random.RandomState(0)
+    Xd = rng.rand(500, 8)
+    yd = (Xd[:, 0] > 0.5).astype(float)
+    b_dense = _train(Xd, yd, rounds=1)
+    assert b_dense._gbdt.bundle is None
+
+
+def test_code_feat_table_contract():
+    """The host-built inverse code map: every owned code decodes back to
+    its member's original bin; code 0, padding, and default-bin holes are
+    unowned (round-trip against the forward plan tables)."""
+    from lightgbm_tpu.efb import build_code_feat, plan_bundles
+    X, y = _mixed_sparse(n=800)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct(Config.from_params(dict(verbose=-1)))
+    cd = ds.constructed
+    meta = cd.feature_meta_arrays()
+    nb = meta["num_bins"].astype(np.int64)
+    db = meta["default_bin"].astype(np.int64)
+    plan = plan_bundles(cd.X_binned, nb, db, cd.config)
+    assert plan is not None
+    G = plan.num_groups
+    Bb = int(plan.group_total_bins.max())
+    cf = build_code_feat(plan, G, Bb, db)
+    for g in range(G):
+        assert cf[g, 0] == -1                      # code 0 = all-default
+        for c in range(Bb):
+            f = cf[g, c]
+            if f < 0:
+                continue
+            assert plan.col[f] == g
+            assert plan.lo[f] <= c < plan.hi[f]
+            b = c - plan.off[f]
+            assert 0 <= b < nb[f] and b != db[f]
+            assert plan.unpack_bin[f, b] == c      # inverse of the forward map

@@ -239,6 +239,54 @@ def test_measured_collectives_match_analytic_band():
         obs.reset_for_tests()
 
 
+def test_measured_collectives_match_analytic_band_bundled():
+    """Same HLO-vs-analytic validation for a BUNDLED 8-device data-parallel
+    run (DataParallelBundledComm): the reduce-scatter payload must match
+    the bundle-space ``num_bundles * hist_bins`` estimate — the satellite
+    fix for estimates that charged feature-space widths on bundled runs —
+    within the same PR-7 0.5-2.0 band."""
+    from lightgbm_tpu import observability as obs
+    from lightgbm_tpu.observability import costs
+    from lightgbm_tpu.parallel.comm import DataParallelBundledComm
+    obs.reset_for_tests()
+    try:
+        costs.configure(enabled=True)
+        rng = np.random.RandomState(4)
+        n, groups, per = 2000, 5, 16
+        flags = np.zeros((n, groups * per))
+        picks = rng.randint(0, per, size=(n, groups))
+        for g in range(groups):
+            flags[np.arange(n), g * per + picks[:, g]] = 1.0
+        y = (picks[:, 0] % 2).astype(np.float64)
+        params = dict(BASE, tree_learner="data", tree_batch=1,
+                      tpu_hist_kernel="xla")
+        ds = lgb.Dataset(flags, label=y, params=params)
+        bst = lgb.Booster(params=params, train_set=ds)
+        g = bst._gbdt
+        assert g.bundle is not None and isinstance(g.comm,
+                                                   DataParallelBundledComm)
+        bst.update()
+        rep = costs.report("train_step.k1")
+        assert rep and rep.get("collectives"), rep
+        coll = rep["collectives"]
+        assert "reduce-scatter" in coll and "all-gather" in coll
+        analytic = g.comm.collective_bytes(
+            g.spec.hist_slots, g.spec.num_bins_padded,
+            use_categorical=g.spec.use_categorical,
+            hist_bins=g.spec.hist_bins)
+        wire = costs.collective_wire_bytes(coll, g.pctx.num_devices)
+        ratio_rs = wire["reduce-scatter"] / analytic["psum_scatter_hist"]
+        assert 0.5 < ratio_rs < 2.0, (wire, analytic)
+        ratio_ag = wire["all-gather"] / analytic["allgather_splits"]
+        assert 0.5 < ratio_ag < 2.0, (wire, analytic)
+        # the old feature-space estimate would be far outside the band
+        feature_space = (g.spec.hist_slots * g.spec.num_features
+                         * g.spec.num_bins_padded * 3 * 4)
+        assert wire["reduce-scatter"] / feature_space < 0.5
+    finally:
+        obs.reset_for_tests()
+
+
 def test_hlo_collectives_async_tuple_counts_result_half_only():
     """TPU lowers async collectives as tuple-shaped `-start` ops
     ((aliased operands..., results...)); only the result half is the
