@@ -34,6 +34,7 @@ verify: lint
 	env JAX_PLATFORMS=cpu $(PYTEST) tests/ -m 'not slow'
 	python bench.py --smoke
 	$(MAKE) stream
+	$(MAKE) linear
 	$(MAKE) serve
 	$(MAKE) serve-chaos
 	$(MAKE) bench-diff
@@ -60,6 +61,18 @@ stream:
 sparse:
 	env LGBM_TPU_BENCH_PLATFORM=cpu LGBM_TPU_BENCH_SPARSE_ROWS=60000 \
 	    LGBM_TPU_BENCH_SPARSE_FEATS=256 python bench.py --sparse
+
+# Piecewise-linear leaves phase (docs/Linear-Trees.md): hermetic-CPU A/B
+# of linear_tree=true vs constant leaves at fixed tree count on a
+# piecewise-linear synthetic — asserts the linear arm wins on holdout L2,
+# trains real linear leaves, stays 0-recompile with the solve leg on, and
+# serves bit-identically through a proto -> ServingEngine round trip.
+# Bank with LGBM_TPU_LINEAR_OUT=LINEAR_r<N>.json; `bench.py --compare`
+# judges the newest banked file under the |linear= comparability key.
+# Bigger N: LGBM_TPU_LINEAR_ROWS=500000 make linear.
+linear:
+	env LGBM_TPU_LINEAR_ROWS=20000 LGBM_TPU_LINEAR_ITERS=5 \
+	    python bench.py --linear
 
 # Serving smoke (docs/Serving.md): hermetic-CPU train -> protobuf ->
 # ServingEngine round trip asserting bit-identity with the training
@@ -150,4 +163,5 @@ trace:
 	@echo "trace: $$(ls -1t .telemetry/trace_*.json | head -1)"
 
 .PHONY: lint verify check-fast check capi bench-cpu chaos bench-chaos \
-        trace bench-diff ledger multichip stream serve serve-chaos sparse
+        trace bench-diff ledger multichip stream serve serve-chaos sparse \
+        linear

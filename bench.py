@@ -1440,6 +1440,61 @@ def run_smoke():
     except Exception as e:            # noqa: BLE001 — any failure fails CI
         efb_ok, efb_err = False, f"{type(e).__name__}: {e}"
 
+    # ---- linear-tree guarded loop (docs/Linear-Trees.md) -------------------
+    # The linear_tree=true step — grow + path-feature walk + chunked moment
+    # accumulation + batched Cholesky solve, all one jit — must add ZERO
+    # post-warm-up recompiles and no host syncs beyond the dense loop's
+    # one intended drain, and the standalone solve-leg cost site
+    # (linear_cost_report) must land a capture so cost.* gauges and the
+    # ledger drift gate cover the new leg.
+    lin_ok, lin_err = True, None
+    lin_misses, lin_syncs = -1, -1
+    try:
+        rng_l = np.random.RandomState(9)
+        Xl = (rng_l.randn(4096, 8) * 2.0).astype(np.float64)
+        yl = np.where(Xl[:, 0] > 0, 3.0 * Xl[:, 1], -2.0 * Xl[:, 2])
+        Xl[rng_l.rand(4096, 8) < 0.02] = np.nan
+        params_l = dict(params, objective="regression", num_leaves=15,
+                        linear_tree=True, linear_lambda=0.01,
+                        linear_max_features=4)
+        ds_l = lgb.Dataset(Xl, label=yl, params=params_l)
+        bst_l = lgb.Booster(params=params_l, train_set=ds_l)
+        for _ in range(2):
+            bst_l.update()
+        np.asarray(bst_l._gbdt.score).sum()
+        guard_l = RecompileGuard(label="smoke-linear")
+        guard_l.register(bst_l._gbdt._step_fn, "train_step")
+        with guard_l:
+            guard_l.mark_warm()
+            for _ in range(iters):
+                bst_l.update()
+            np.asarray(bst_l._gbdt.score).sum()
+        rep_l = guard_l.report()
+        lin_misses = rep_l["post_warmup_cache_misses"]
+        lin_syncs = rep_l["host_syncs"]
+        if lin_misses:
+            raise RuntimeError(
+                f"linear-tree step recompiled: {lin_misses} post-warm-up "
+                f"cache miss(es) — the solve leg leaked a dynamic shape")
+        if lin_syncs > report["host_syncs"]:
+            raise RuntimeError(
+                f"linear leaves added host syncs: {lin_syncs} vs the "
+                f"dense loop's {report['host_syncs']}")
+        from lightgbm_tpu.ops.linear import linear_cost_report
+        lrep = linear_cost_report(
+            n_rows=4096, num_features=bst_l._gbdt.spec.num_features,
+            num_leaves=15, max_features=4,
+            chunk_rows=bst_l._gbdt.spec.chunk_rows)
+        if lrep.get("error"):
+            raise RuntimeError(
+                f"solve-leg cost capture failed: {lrep['error']}")
+        if obs_costs.report(lrep["site"]) is None:
+            raise RuntimeError("solve-leg cost report did not publish")
+    except GuardViolation as e:
+        lin_ok, lin_err = False, str(e)
+    except Exception as e:            # noqa: BLE001 — any failure fails CI
+        lin_ok, lin_err = False, f"{type(e).__name__}: {e}"
+
     # ---- golden cost pin for the fused step (observability/costs.py) -------
     # The fused train step's compile-time FLOPs/bytes-accessed must sit
     # inside the tolerance band of the committed goldens
@@ -1488,8 +1543,11 @@ def run_smoke():
            "efb_bundlespace_ok": efb_ok,
            "efb_post_warmup_cache_misses": efb_misses,
            "efb_host_syncs": efb_syncs,
+           "linear_ok": lin_ok,
+           "linear_post_warmup_cache_misses": lin_misses,
+           "linear_host_syncs": lin_syncs,
            "ok": (ok and resume_ok and cache_ok and tel_ok and cost_ok
-                  and rob_ok and efb_ok)}
+                  and rob_ok and efb_ok and lin_ok)}
     if err:
         out["error"] = err[:300]
     if resume_err:
@@ -1504,8 +1562,180 @@ def run_smoke():
         out["robustness_error"] = rob_err[:300]
     if efb_err:
         out["efb_error"] = efb_err[:300]
+    if lin_err:
+        out["linear_error"] = lin_err[:300]
     print(json.dumps(out))
     return 0 if out["ok"] else 1
+
+
+# ------------------------------------------------------------ linear phase
+
+def _piecewise_linear_data(n_rows, f=8, seed=17):
+    """Piecewise-linear synthetic: the target's SLOPE switches with the
+    sign of feature 0 — a constant-leaf tree must staircase what a linear
+    leaf fits exactly, so accuracy-at-fixed-trees separates the two leaf
+    models cleanly. A few NaN cells exercise the constant fallback."""
+    rng = np.random.RandomState(seed)
+    X = (rng.randn(n_rows, f) * 2.0).astype(np.float64)
+    X[rng.rand(n_rows, f) < 0.01] = np.nan
+    y = np.where(np.nan_to_num(X[:, 0]) > 0,
+                 3.0 * np.nan_to_num(X[:, 1]) + 1.0,
+                 -2.0 * np.nan_to_num(X[:, 2]) + 0.5) \
+        + 0.05 * rng.randn(n_rows)
+    return X, y
+
+
+def run_linear(argv=None):
+    """`bench.py --linear`: the piecewise-linear-leaves phase
+    (linear_tree=true, ops/linear.py; docs/Linear-Trees.md). Hermetic CPU,
+    like --smoke. A/B at FIXED tree count on a piecewise-linear synthetic:
+
+    1. THROUGHPUT — linear vs constant leaves (the fit leg's measured
+       price: path-feature walk + chunked moment accumulation + batched
+       Cholesky, all fused into the train step);
+    2. ACCURACY-AT-FIXED-TREES — holdout L2 of both arms after the SAME
+       number of trees; the acceptance gate requires the linear arm to
+       win (that is the workload's reason to exist);
+    3. 0-RECOMPILE — the linear step (waves + solve leg) adds zero jit
+       cache misses after warm-up (RecompileGuard);
+    4. SERVING PARITY — a proto round trip through ServingEngine serves
+       the linear model bit-identically to Booster.predict.
+
+    Prints ONE JSON line (bench schema; linear="linear" keys it into its
+    own perf-ledger comparability class); exit 0 iff the gates hold.
+    LGBM_TPU_LINEAR_OUT banks the payload as LINEAR_r<N>.json."""
+    from lightgbm_tpu.utils.hermetic import force_cpu_backend
+    force_cpu_backend()
+    import time
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis.guards import GuardViolation, RecompileGuard
+    from lightgbm_tpu.observability import costs as obs_costs
+
+    n_rows = int(os.environ.get("LGBM_TPU_LINEAR_ROWS", "60000"))
+    iters = int(os.environ.get("LGBM_TPU_LINEAR_ITERS", "8"))
+    warmup = 2
+    n_hold = max(n_rows // 5, 1000)
+    X, y = _piecewise_linear_data(n_rows + n_hold)
+    Xh, yh = X[n_rows:], y[n_rows:]
+    X, y = X[:n_rows], y[:n_rows]
+    # 16 leaves: coarse enough that a constant-leaf staircase visibly
+    # underfits the piecewise-linear ramps the linear leaves fit exactly —
+    # the A/B separates on MODEL CLASS, not tree count
+    base = dict(objective="regression", num_leaves=16, max_bin=63,
+                learning_rate=0.2, min_data_in_leaf=20, verbose=-1,
+                metric="none", tpu_hist_kernel="xla", seed=11)
+    lam, kmax = 0.01, 4
+
+    out = {"metric": "linear_train_throughput", "unit": "Mrow-tree/s",
+           "platform": "cpu", "rows": n_rows, "iters": iters,
+           "n_devices": 1, "linear": "linear",
+           "linear_lambda": lam, "linear_max_features": kmax}
+    ok, err = True, []
+
+    def timed_arm(params, guard=None):
+        ds = lgb.Dataset(X, label=y, params=params)
+        bst = lgb.Booster(params=params, train_set=ds)
+        for _ in range(warmup):
+            bst.update()
+        np.asarray(bst._gbdt.score).sum()
+        if guard is not None:
+            guard.register(bst._gbdt._step_fn, "train_step")
+        t0 = time.perf_counter()
+        if guard is not None:
+            with guard:
+                guard.mark_warm()
+                for _ in range(iters):
+                    bst.update()
+                np.asarray(bst._gbdt.score).sum()
+        else:
+            for _ in range(iters):
+                bst.update()
+            np.asarray(bst._gbdt.score).sum()
+        el = time.perf_counter() - t0
+        return bst, n_rows * iters / el / 1e6
+
+    # ---- constant arm (the baseline both gates judge against) --------------
+    b_const, tp_const = timed_arm(dict(base, linear_tree=False))
+    out["constant_mrow_tree_per_s"] = _round_tp(tp_const)
+    mse_const = float(np.mean((b_const.predict(Xh) - yh) ** 2))
+    out["mse_constant"] = round(mse_const, 6)
+
+    # ---- linear arm under the guard ----------------------------------------
+    guard = RecompileGuard(label="linear")
+    params_l = dict(base, linear_tree=True, linear_lambda=lam,
+                    linear_max_features=kmax)
+    try:
+        b_lin, tp_lin = timed_arm(params_l, guard=guard)
+    except GuardViolation as e:
+        ok = False
+        err.append(str(e)[:300])
+        b_lin, tp_lin = None, None
+    rep = guard.report()
+    out["recompiles_post_warmup"] = rep["post_warmup_cache_misses"]
+    out["kernel"] = "xla"
+    out["value"] = _round_tp(tp_lin) if tp_lin else None
+    out["linear_vs_constant"] = _round_ratio(tp_lin / tp_const) \
+        if tp_lin else None
+    if b_lin is not None:
+        out["kernel"] = b_lin._gbdt.spec.hist_kernel
+        mse_lin = float(np.mean((b_lin.predict(Xh) - yh) ** 2))
+        out["mse_linear"] = round(mse_lin, 6)
+        out["accuracy_gain_frac"] = round(1.0 - mse_lin / mse_const, 4)
+        n_lin = sum(1 for t in b_lin.trees
+                    for fset in (t.leaf_features or []) if len(fset))
+        n_leaves = sum(t.num_leaves for t in b_lin.trees)
+        out["linear_leaves"] = n_lin
+        out["total_leaves"] = n_leaves
+        if n_lin == 0:
+            ok = False
+            err.append("every leaf degraded to constant — the linear arm "
+                       "trained no linear models")
+        # the acceptance gate: linear leaves must BEAT constant leaves at
+        # fixed tree count on the piecewise-linear shape
+        if mse_lin >= mse_const:
+            ok = False
+            err.append(f"accuracy gate failed: linear mse {mse_lin:.5f} "
+                       f">= constant {mse_const:.5f} at {warmup + iters} "
+                       f"trees")
+        # ---- serving parity: proto round trip, bit-identical ---------------
+        import tempfile
+        with tempfile.TemporaryDirectory(prefix="lgbm_linear_") as td:
+            pb = os.path.join(td, "m.proto")
+            b_lin.save_model(pb)
+            from lightgbm_tpu.serving import ServingEngine
+            with ServingEngine(pb, params=dict(verbose=-1)) as eng:
+                probe = Xh[:256]
+                same = bool(np.array_equal(b_lin.predict(probe),
+                                           eng.predict(probe)))
+            out["identical_to_serving"] = same
+            if not same:
+                ok = False
+                err.append("ServingEngine predictions differ from "
+                           "Booster.predict on the linear model")
+        # solve-leg cost site (observability/costs.py linear_cost_report):
+        # the standalone fit leg's compile-time FLOPs/bytes, for the
+        # cost.* gauges and the ledger drift gate
+        from lightgbm_tpu.ops.linear import linear_cost_report
+        lrep = linear_cost_report(
+            n_rows=n_rows, num_features=b_lin._gbdt.spec.num_features,
+            num_leaves=b_lin._gbdt.spec.num_leaves, max_features=kmax,
+            chunk_rows=b_lin._gbdt.spec.chunk_rows)
+        if not lrep.get("error"):
+            out["cost_reports"] = {lrep["site"]: {
+                k: lrep.get(k) for k in
+                ("flops", "bytes_accessed", "peak_hbm_bytes")
+                if lrep.get(k) is not None}}
+
+    out["ok"] = ok
+    if err:
+        out["error"] = "; ".join(err)[:500]
+    print(json.dumps(out))
+    out_path = os.environ.get("LGBM_TPU_LINEAR_OUT", "")
+    if out_path:
+        from lightgbm_tpu.observability.export import atomic_write_json
+        atomic_write_json(out_path, out)
+    return 0 if ok else 1
 
 
 # ------------------------------------------------------------ stream phase
@@ -2748,6 +2978,26 @@ def run_compare(argv):
                              "problems": bp, "notes": bn, "ok": not bp}
             problems = problems + bp
             break
+        # ... and the newest banked LINEAR result (bench.py --linear): the
+        # |linear= comparability key means the ridge-solve workload is
+        # only judged against linear-leaf history — a fit-leg throughput
+        # regression fails here without touching constant-leaf numbers
+        for p in reversed(sorted(
+                _glob.glob(os.path.join(repo, "LINEAR_r*.json")))):
+            pl = perf_ledger.payload_of(p)
+            if not pl or pl.get("metric") != "linear_train_throughput":
+                continue
+            lp, lnn = perf_ledger.compare(
+                pl, entries, exclude_source=os.path.basename(p))
+            out["linear"] = {"candidate": os.path.basename(p),
+                             "value": pl.get("value"),
+                             "accuracy_gain_frac":
+                                 pl.get("accuracy_gain_frac"),
+                             "identical_to_serving":
+                                 pl.get("identical_to_serving"),
+                             "problems": lp, "notes": lnn, "ok": not lp}
+            problems = problems + lp
+            break
         # ... and the newest banked SERVE_CHAOS result (bench.py
         # --serve-chaos): the |serve_chaos= comparability key gates the
         # shed-rate ceiling and p99-under-overload, so a serving-
@@ -2781,6 +3031,8 @@ if __name__ == "__main__":
         sys.exit(run_smoke())
     elif "--stream" in sys.argv:
         sys.exit(run_stream(sys.argv))
+    elif "--linear" in sys.argv:
+        sys.exit(run_linear(sys.argv))
     elif "--serve-chaos" in sys.argv:
         sys.exit(run_serve_chaos(sys.argv))
     elif "--serve" in sys.argv:

@@ -440,7 +440,20 @@ class Booster:
             Log.fatal("add_valid after training needs the valid set's "
                       "raw data to replay the forest — construct it "
                       "with free_raw_data=False")
-        self._gbdt.add_valid(name, data._binned_aligned, data._metadata)
+        valid_raw = None
+        if getattr(self.config, "linear_tree", False):
+            # linear-leaf score updates need raw values for the valid rows
+            if data.raw_data is None:
+                Log.fatal("linear_tree=true: add_valid needs the valid "
+                          "set's raw data (construct it with "
+                          "free_raw_data=False)")
+            from .dataset import extract_raw_slice
+            cd = self.train_dataset.constructed
+            valid_raw = extract_raw_slice(
+                data.raw_data, [int(r) for r in cd.real_feature_idx],
+                data.raw_data.shape[0])
+        self._gbdt.add_valid(name, data._binned_aligned, data._metadata,
+                             raw=valid_raw)
         self._valid_registry.append((data, name))
         # replay the already-trained forest into the new valid score (the
         # reference's AddValidDataset replays iter_ trees; without this,
@@ -689,6 +702,15 @@ class Booster:
             out = np.stack([t.predict_leaf(X) for t in use_trees], axis=1)
             return out
         if pred_contrib:
+            if any(t.is_linear for t in use_trees):
+                # TreeSHAP walks constant leaf outputs; attributing a
+                # per-leaf linear model needs interventional SHAP over the
+                # coefficients — fail loudly rather than return constants
+                # that ignore the linear terms
+                Log.fatal("pred_contrib is not supported for linear-tree "
+                          "models (linear_tree=true): TreeSHAP "
+                          "contributions are defined over constant leaf "
+                          "outputs")
             # TreeSHAP contributions, [N, (F+1)*K] like the reference python
             # package (basic.py predict pred_contrib; tree.h:340 PredictContrib)
             F1 = self.num_total_features + 1

@@ -64,6 +64,10 @@ class ValidSet(MetadataDuckTyping):
         self.metrics = metrics
         self.num_data = num_data
         self.score: Optional[jnp.ndarray] = None
+        # linear_tree=true only: device raw-feature slice (NaN-sanitized)
+        # + missing plane for the valid-score linear epilogue
+        self.Xraw: Optional[jnp.ndarray] = None
+        self.Xmiss: Optional[jnp.ndarray] = None
 
 
 class GBDT:
@@ -633,6 +637,60 @@ class GBDT:
                 default_bin=self.default_bin, is_cat=self.is_cat,
                 bundle=self.bundle)
 
+        # ---- piecewise-linear leaves (linear_tree=true, ops/linear.py) -----
+        # the per-leaf ridge fit reads RAW f32 feature values the binned
+        # matrix discards: a NaN-sanitized [Npad, F_pad] slice plus its
+        # missing plane become step constants (cached on the dataset like
+        # Xb). v1 scope: single-device, non-streamed, row-replicated —
+        # every unsupported combination rejects loudly here, never trains
+        # silently-wrong coefficients.
+        self.linear_tree = bool(config.linear_tree)
+        self.Xraw = None
+        self.Xmiss = None
+        self._linear_max_steps = 1
+        if self.linear_tree:
+            if self.pctx.strategy == "feature":
+                Log.fatal("linear_tree=true is not supported with "
+                          "tree_learner=feature (the raw-feature slice is "
+                          "row-aligned; use serial)")
+            if self.pctx.num_devices > 1 or self.pctx.multi_process:
+                Log.fatal("linear_tree=true is single-device for now "
+                          "(%d devices requested): the per-leaf moment "
+                          "accumulation is not wired through the mesh "
+                          "collectives yet", self.pctx.num_devices)
+            if config.is_pre_partition:
+                Log.fatal("linear_tree=true is not supported with "
+                          "is_pre_partition")
+            raw_np = getattr(train_set, "X_raw", None)
+            if raw_np is None:
+                Log.fatal("linear_tree=true needs the dataset's raw feature "
+                          "slice, which this dataset was constructed "
+                          "without — rebuild the Dataset with "
+                          "linear_tree=true in its params (binary dataset "
+                          "files save it only when written under "
+                          "linear_tree)")
+            raw_pad = np.zeros((Npad, F_pad), np.float32)
+            raw_pad[:N, :F] = raw_np
+            miss_pad = np.isnan(raw_pad)
+            np.nan_to_num(raw_pad, copy=False, nan=0.0,
+                          posinf=np.float32(np.finfo(np.float32).max),
+                          neginf=np.float32(np.finfo(np.float32).min))
+            self.Xraw = train_set.device_put_cached(
+                ("Xraw", Npad, F_pad, self.pctx.residency_key()),
+                lambda: self._put(raw_pad, "rows0"))
+            self.Xmiss = train_set.device_put_cached(
+                ("Xmiss", Npad, F_pad, self.pctx.residency_key()),
+                lambda: self._put(miss_pad, "rows0"))
+            # path depth bound for the leaf->root feature walk
+            depth_cap = config.max_depth if config.max_depth > 0 \
+                else num_leaves - 1
+            self._linear_max_steps = max(1, min(num_leaves - 1, depth_cap))
+            Log.info("linear_tree: per-leaf ridge solves on (lambda=%g, "
+                     "max_features=%d); raw slice %.2f MB + %.2f MB missing "
+                     "plane device-resident", config.linear_lambda,
+                     config.linear_max_features,
+                     raw_pad.nbytes / (1 << 20), miss_pad.nbytes / (1 << 20))
+
         # feature_fraction: number of features used per tree
         self.n_feature_sample = max(1, int(round(config.feature_fraction * F)))
         self.use_feature_fraction = config.feature_fraction < 1.0 and self.n_feature_sample < F
@@ -809,6 +867,10 @@ class GBDT:
             return False, (f"boosting={config.boosting_normalized} keeps "
                            f"host-side per-tree state that reads the "
                            f"resident code matrix")
+        if getattr(config, "linear_tree", False):
+            return False, ("linear_tree=true keeps the raw feature slice "
+                           "device-resident (the per-leaf fits read raw "
+                           "values every tree)")
         if self.pctx.strategy == "feature":
             return False, ("tree_learner=feature replicates rows and "
                            "slices columns at trace time; stream shards "
@@ -874,7 +936,9 @@ class GBDT:
             incremental=config.tpu_incremental_partition,
             bagging=(config.bagging_freq > 0
                      and config.bagging_fraction < 1.0),
-            tree_batch=max(1, config.tree_batch))
+            tree_batch=max(1, config.tree_batch),
+            linear_max_features=(config.linear_max_features
+                                 if config.linear_tree else 0))
         if est["total_bytes"] <= budget:
             return "device"
         gb = 1 << 30
@@ -959,7 +1023,8 @@ class GBDT:
                                                 lambda idx: x[idx])
         return jax.device_put(jnp.asarray(x), sharding)
 
-    def add_valid(self, name: str, binned: np.ndarray, metadata: Metadata) -> None:
+    def add_valid(self, name: str, binned: np.ndarray, metadata: Metadata,
+                  raw: Optional[np.ndarray] = None) -> None:
         nv = binned.shape[0]
         metrics = create_metrics(self.config, self.objective.name if self.objective else None)
         for m in metrics:
@@ -968,6 +1033,19 @@ class GBDT:
         if binned.shape[1] < F_pad:
             binned = np.pad(binned, ((0, 0), (0, F_pad - binned.shape[1])))
         vs = ValidSet(name, self._put(binned), metadata, metrics, nv)
+        if self.linear_tree:
+            # the valid-score updates run the linear epilogue — they need
+            # the same sanitized raw slice the training rows carry
+            if raw is None:
+                Log.fatal("linear_tree=true: valid set %r needs its raw "
+                          "feature values (construct it with "
+                          "free_raw_data=False)", name)
+            raw_pad = np.zeros((nv, F_pad), np.float32)
+            raw_pad[:, : raw.shape[1]] = np.asarray(raw, np.float32)
+            miss_pad = np.isnan(raw_pad)
+            np.nan_to_num(raw_pad, copy=False, nan=0.0)
+            vs.Xraw = self._put(raw_pad)
+            vs.Xmiss = self._put(miss_pad)
         base = np.full((self.num_models, nv), self.init_score_value, dtype=np.float32)
         if metadata.init_score is not None:
             base += np.asarray(metadata.init_score, np.float32).reshape(
@@ -1032,6 +1110,11 @@ class GBDT:
         tree = tree._replace(
             leaf_value=tree.leaf_value * shrinkage,
             internal_value=tree.internal_value * shrinkage)
+        if tree.leaf_const is not None:
+            # linear leaves shrink intercept + coefficients with the
+            # constant (the reference scales the whole leaf model)
+            tree = tree._replace(leaf_const=tree.leaf_const * shrinkage,
+                                 leaf_coeff=tree.leaf_coeff * shrinkage)
         tree = self._tree_output_transform(tree)
         if self.nan_policy == "none":
             return tree, None
@@ -1046,17 +1129,29 @@ class GBDT:
     def _tree_score_updates(self, score_k, valid_k, valid_Xb, tree,
                             leaf_ids, it):
         """Apply one (shrunk) tree to the train score and every valid
-        score: ``(new_score_k, [new_valid_k...])``."""
-        new_score_k = self._score_update(
-            score_k, table_lookup(leaf_ids, tree.leaf_value), it)
+        score: ``(new_score_k, [new_valid_k...])``. Linear trees swap the
+        constant-leaf table lookup for the per-row linear epilogue
+        (ops/linear.linear_leaf_scores) on both paths."""
+        if self.linear_tree:
+            from ..ops.linear import linear_leaf_scores
+            contrib = linear_leaf_scores(tree, leaf_ids, self.Xraw,
+                                         self.Xmiss)
+        else:
+            contrib = table_lookup(leaf_ids, tree.leaf_value)
+        new_score_k = self._score_update(score_k, contrib, it)
         new_valid_k = []
         for vi in range(len(valid_Xb)):
             vleaf = leaves_from_binned(
                 tree, valid_Xb[vi], self.num_bins, self.missing_code,
                 self.default_bin,
                 use_categorical=self.spec.use_categorical)
-            new_valid_k.append(self._score_update(
-                valid_k[vi], table_lookup(vleaf, tree.leaf_value), it))
+            if self.linear_tree:
+                from ..ops.linear import linear_leaf_scores
+                vs = self.valid_sets[vi]
+                vcontrib = linear_leaf_scores(tree, vleaf, vs.Xraw, vs.Xmiss)
+            else:
+                vcontrib = table_lookup(vleaf, tree.leaf_value)
+            new_valid_k.append(self._score_update(valid_k[vi], vcontrib, it))
         return new_score_k, new_valid_k
 
     # device-array attributes captured by the training step; under
@@ -1064,11 +1159,18 @@ class GBDT:
     # spanning non-addressable devices is rejected), so the step rebinds
     # them onto self for the duration of the trace.
     _STEP_CONSTS = ("Xb", "label", "weight", "pad_mask", "feature_ok_base",
-                    "is_cat", "num_bins", "missing_code", "default_bin")
+                    "is_cat", "num_bins", "missing_code", "default_bin",
+                    "Xraw", "Xmiss")
 
     def _step_consts(self):
-        return ({a: getattr(self, a) for a in self._STEP_CONSTS},
-                tuple(vs.Xb for vs in self.valid_sets))
+        consts = {a: getattr(self, a) for a in self._STEP_CONSTS}
+        # linear_tree: per-valid raw slices ride in the consts pytree (the
+        # step rebinds them like vs.Xb, so they travel as jit ARGUMENTS and
+        # are never baked into the executable as constants)
+        consts["valid_raw"] = tuple((vs.Xraw, vs.Xmiss)
+                                    for vs in self.valid_sets) \
+            if self.linear_tree else None
+        return consts, tuple(vs.Xb for vs in self.valid_sets)
 
     def _make_step(self, custom_grads: bool = False, batch: int = 1):
         assert not (custom_grads and batch > 1), \
@@ -1076,6 +1178,7 @@ class GBDT:
         spec = self.spec
         K = self.num_models
         comm = self.comm
+        linear_tree = self.linear_tree    # static per booster
 
         bundle = self.bundle              # EFB: native arm scans/routes in
                                           # bundle space end-to-end; legacy
@@ -1096,10 +1199,15 @@ class GBDT:
             # tracing; compiled executions never run this body again.
             saved = {a: getattr(self, a) for a in self._STEP_CONSTS}
             saved_vXb = [vs.Xb for vs in self.valid_sets]
+            saved_vraw = [(vs.Xraw, vs.Xmiss) for vs in self.valid_sets]
             for a in self._STEP_CONSTS:
                 setattr(self, a, consts[a])
             for vs, xb in zip(self.valid_sets, valid_Xb):
                 vs.Xb = xb
+            if linear_tree:     # static: self.linear_tree, fixed per booster
+                for vs, (xr, xm) in zip(self.valid_sets,
+                                        consts["valid_raw"]):
+                    vs.Xraw, vs.Xmiss = xr, xm
             try:
                 if batch == 1:
                     return step_body(score, valid_scores, bag_mask, key, it,
@@ -1111,6 +1219,8 @@ class GBDT:
                     setattr(self, a, v)
                 for vs, xb in zip(self.valid_sets, saved_vXb):
                     vs.Xb = xb
+                for vs, (xr, xm) in zip(self.valid_sets, saved_vraw):
+                    vs.Xraw, vs.Xmiss = xr, xm
 
         def batch_body(score, valid_scores, bag_mask, key, it, shrinkage):
             # tree_batch fusion: `batch` whole iterations under ONE lax.scan
@@ -1166,6 +1276,18 @@ class GBDT:
                 tree, leaf_ids = grow(
                     self.Xb, g[k] * mask, h[k] * mask, mask, fmask, self.is_cat,
                     self.num_bins, self.missing_code, self.default_bin)
+                if self.linear_tree:
+                    # per-leaf ridge fit (ops/linear.py): same masked g/h
+                    # the tree grew on, BEFORE shrinkage so the intercept
+                    # and coefficients scale together (Tree::Shrinkage)
+                    from ..ops.linear import fit_linear_leaves
+                    tree = fit_linear_leaves(
+                        tree, self.Xraw, self.Xmiss, leaf_ids,
+                        g[k] * mask, h[k] * mask, mask, self.is_cat,
+                        max_features=self.config.linear_max_features,
+                        linear_lambda=self.config.linear_lambda,
+                        chunk_rows=spec.chunk_rows,
+                        max_steps=self._linear_max_steps)
                 tree, bl = self._shrink_transform_flag(tree, shrinkage)
                 if bl is not None:
                     bad_leaf = bl if bad_leaf is None else (bad_leaf | bl)
@@ -1726,11 +1848,24 @@ class GBDT:
             leaves = leaves_from_binned(tree, self.Xb, self.num_bins,
                                         self.missing_code, self.default_bin,
                                         bundle=self.bundle)
-            new_scores.append(score[k] - tree.leaf_value[leaves])
+            if self.linear_tree:
+                # subtract the SAME per-row linear output the step added
+                from ..ops.linear import linear_leaf_scores
+                contrib = linear_leaf_scores(tree, leaves, self.Xraw,
+                                             self.Xmiss)
+            else:
+                contrib = tree.leaf_value[leaves]
+            new_scores.append(score[k] - contrib)
             for vs in self.valid_sets:
                 vleaves = leaves_from_binned(tree, vs.Xb, self.num_bins,
                                              self.missing_code, self.default_bin)
-                vs.score = vs.score.at[k].add(-tree.leaf_value[vleaves])
+                if self.linear_tree:
+                    from ..ops.linear import linear_leaf_scores
+                    vcontrib = linear_leaf_scores(tree, vleaves, vs.Xraw,
+                                                  vs.Xmiss)
+                else:
+                    vcontrib = tree.leaf_value[vleaves]
+                vs.score = vs.score.at[k].add(-vcontrib)
         self.score = jnp.stack(new_scores)
 
     def reset_config(self, new_config: Config) -> None:
@@ -1765,6 +1900,14 @@ class GBDT:
         if old.min_data_per_group != new_config.min_data_per_group:
             spec_changes["min_data_per_group"] = float(new_config.min_data_per_group)
         retrace = bool(spec_changes)
+        if old.linear_tree != new_config.linear_tree:
+            # structural: the raw slice placement and every score-update
+            # epilogue are decided at construction
+            Log.fatal("linear_tree cannot change via reset_parameter "
+                      "(rebuild the Booster)")
+        if (old.linear_lambda != new_config.linear_lambda
+                or old.linear_max_features != new_config.linear_max_features):
+            retrace = True
         if spec_changes:
             import dataclasses
             self.spec = dataclasses.replace(self.spec, **spec_changes)
@@ -2097,6 +2240,42 @@ class GBDT:
         if forest and abs(self.init_score_value) > 1e-15:
             for k in range(self.num_models):
                 forest[0][k].add_bias(self.init_score_value)
+        if self.linear_tree and forest:
+            # loud degrade accounting: every leaf either fitted a linear
+            # model or serialized with an EMPTY feature list (constant
+            # fallback) — surface the split so a silently-degraded run is
+            # visible in the log and the metrics registry. High-water
+            # mark: finalize_model re-runs on every _ensure_finalized, so
+            # only iterations not yet accounted count (rollback lowers the
+            # mark; retrained iterations count again like new trees).
+            base = min(getattr(self, "_linear_counted_iters", 0),
+                       len(forest))
+            n_lin = n_const = 0
+            for it_trees in forest[base:]:
+                for t in it_trees:
+                    for li in range(t.num_leaves):
+                        if t.leaf_features is not None and \
+                                len(t.leaf_features[li]):
+                            n_lin += 1
+                        else:
+                            n_const += 1
+            self._linear_counted_iters = len(forest)
+            if n_lin or n_const:
+                reg = obs.get_registry()
+                reg.counter("linear.leaves.linear").inc(n_lin)
+                reg.counter("linear.leaves.constant").inc(n_const)
+                if n_lin == 0 and self.config.tpu_linear_warn_fallback \
+                        and not getattr(self, "_linear_warned", False):
+                    self._linear_warned = True
+                    Log.warning(
+                        "linear_tree: every one of the %d leaves degraded "
+                        "to constant output (categorical paths, too few "
+                        "rows, or ill-conditioned solves) — the model is "
+                        "valid but carries no linear leaves; raise "
+                        "linear_lambda or check the feature set", n_const)
+                else:
+                    Log.info("linear_tree: %d linear leaves, %d constant-"
+                             "fallback leaves", n_lin, n_const)
         return forest
 
 

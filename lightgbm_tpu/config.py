@@ -252,6 +252,24 @@ class Config:
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
     max_cat_to_onehot: int = 4
+    # --- piecewise-linear leaves (ops/linear.py, docs/Linear-Trees.md) ------
+    # fit a linear model per leaf over the leaf's path features instead of a
+    # constant (arXiv 1802.05640; later-LightGBM linear_tree). The per-leaf
+    # ridge solves run INSIDE the training step as one batched Cholesky —
+    # zero extra dispatches. Changes the model: fingerprinted for
+    # checkpoint/resume, like linear_lambda / linear_max_features.
+    linear_tree: bool = False
+    # ridge term added to the coefficient diagonal of every leaf's normal
+    # equations (never the intercept); 0 = plain least squares with loud
+    # degradation to constant leaves on singular systems
+    linear_lambda: float = 0.0
+    # cap on distinct numerical path features per leaf (leaf-to-root order:
+    # the nearest splits enter first)
+    linear_max_features: int = 8
+    # warn (once per train()) when leaves degrade to constant output
+    # (categorical path / too few rows / ill-conditioned solve) — loudness
+    # knob only, never the math: VOLATILE_CONFIG_FIELDS
+    tpu_linear_warn_fallback: bool = True
 
     # --- boosting (config.h:236-260) ----------------------------------------
     boosting_type: str = "gbdt"               # gbdt | dart | goss | rf
@@ -642,6 +660,22 @@ class Config:
                 parse_profile_iters(self.tpu_profile_iters)
             except ValueError as e:
                 Log.fatal("%s", e)
+        if self.linear_lambda < 0:
+            Log.fatal("linear_lambda must be >= 0, got %g", self.linear_lambda)
+        if self.linear_max_features < 1:
+            Log.fatal("linear_max_features must be >= 1, got %d",
+                      self.linear_max_features)
+        if self.linear_tree and self.boosting_normalized in ("dart", "rf"):
+            # dart replays/subtracts dropped trees through the constant-leaf
+            # table path and rf transforms leaf outputs through the
+            # objective — neither composes with per-leaf linear models;
+            # reject at config time, never train silently-wrong coefficients
+            Log.fatal("linear_tree=true is not supported with boosting=%s "
+                      "(use gbdt or goss)", self.boosting_type)
+        if self.linear_tree and self.tpu_residency == "stream":
+            Log.fatal("linear_tree=true needs the raw feature slice "
+                      "device-resident and is not supported with "
+                      "tpu_residency=stream (use device)")
         if self.boosting_normalized == "dart" and (self.checkpoint_dir
                                                    or self.resume_from):
             # reject at config time, not at the first save: otherwise the

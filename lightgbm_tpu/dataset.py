@@ -136,6 +136,11 @@ class ConstructedDataset(MetadataDuckTyping):
         counts = np.array([m.num_bin for m in self.mappers], dtype=np.int64)
         self.bin_offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
         self.num_bins_per_feature = counts.astype(np.int32)
+        # raw f32 slice of the used features (linear_tree=true only,
+        # ops/linear.py): the per-leaf ridge fits read raw values, which
+        # binning otherwise discards — construct_dataset fills it when the
+        # config asks for linear trees; None everywhere else (zero cost)
+        self.X_raw: Optional[np.ndarray] = None
         # sharded device residency (boosting/gbdt.py): the padded binned
         # code matrix placed on the booster's mesh, cached per placement
         # key so the dataset's device residency is first-class — every
@@ -248,6 +253,7 @@ class ConstructedDataset(MetadataDuckTyping):
                 "query_boundaries": self.metadata.query_boundaries,
                 "init_score": self.metadata.init_score,
                 "config": self.config.to_dict(),
+                "X_raw": self.X_raw,
             }, fh, protocol=pickle.HIGHEST_PROTOCOL)
 
     @classmethod
@@ -266,6 +272,7 @@ class ConstructedDataset(MetadataDuckTyping):
                     for r, m in zip(blob["real_feature_idx"], blob["mappers"])]
         ds = cls(blob["X_binned"], features, blob["num_total_features"], meta,
                  blob["feature_names"], Config.from_params(blob["config"]))
+        ds.X_raw = blob.get("X_raw")   # present iff saved under linear_tree
         return ds
 
 
@@ -450,5 +457,28 @@ def construct_dataset(
     metadata.set_group(group)
     metadata.set_init_score(init_score)
 
-    return ConstructedDataset(X_binned, features, num_total_features, metadata,
-                              feature_names, config)
+    ds = ConstructedDataset(X_binned, features, num_total_features, metadata,
+                            feature_names, config)
+    if getattr(config, "linear_tree", False):
+        ds.X_raw = extract_raw_slice(
+            data, [f.real_index for f in features], num_data)
+    return ds
+
+
+def extract_raw_slice(data, real_indices, num_data: int) -> np.ndarray:
+    """[N, used_features] f32 raw values (NaN preserved) for linear-tree
+    fits — the used-feature column slice of the input, densified from
+    sparse inputs column-by-column (implicit zeros stay numeric 0.0, so
+    only true NaNs take the constant-leaf fallback)."""
+    out = np.zeros((num_data, max(len(real_indices), 1)), np.float32)
+    if hasattr(data, "tocsc"):
+        csc = data.tocsc()
+        for inner, real in enumerate(real_indices):
+            rows, vals = _csc_column(csc, real)
+            if len(rows):
+                out[rows, inner] = vals.astype(np.float32)
+        return out
+    data = np.asarray(data)
+    for inner, real in enumerate(real_indices):
+        out[:, inner] = np.asarray(data[:, real], np.float32)
+    return out

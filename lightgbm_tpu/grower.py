@@ -71,6 +71,15 @@ class TreeArrays(NamedTuple):
     leaf_count: jnp.ndarray       # f32 [L+1]
     leaf_parent: jnp.ndarray      # i32 [L+1]
     num_leaves: jnp.ndarray       # i32 scalar: leaves actually grown
+    # piecewise-linear leaves (linear_tree=true, ops/linear.py): populated
+    # by fit_linear_leaves AFTER growth, None otherwise (None is a static
+    # empty pytree node, so constant-leaf training never carries them).
+    # leaf_feat holds INNER feature indices (-1 pad; all -1 = constant
+    # leaf); a linear leaf's output is leaf_const + leaf_coeff . x, with
+    # leaf_value kept as the missing-value / degraded fallback.
+    leaf_feat: Optional[jnp.ndarray] = None    # i32 [L+1, K]
+    leaf_coeff: Optional[jnp.ndarray] = None   # f32 [L+1, K]
+    leaf_const: Optional[jnp.ndarray] = None   # f32 [L+1]
 
 
 class BundleDecode(NamedTuple):

@@ -49,11 +49,18 @@ def _node_to_dict(tree: Tree, index: int) -> Dict:
         node["right_child"] = _node_to_dict(tree, int(tree.right_child[index]))
         return node
     leaf = ~index
-    return {
+    out = {
         "leaf_index": leaf,
         "leaf_value": float(tree.leaf_value[leaf]),
         "leaf_count": int(tree.leaf_count[leaf]),
     }
+    if tree.leaf_features is not None and len(tree.leaf_features[leaf]):
+        # linear leaf (later-LightGBM dump_model convention): intercept +
+        # per-feature coefficients; leaf_value stays the NaN fallback
+        out["leaf_const"] = float(tree.leaf_const[leaf])
+        out["leaf_features"] = [int(f) for f in tree.leaf_features[leaf]]
+        out["leaf_coeff"] = [float(c) for c in tree.leaf_coeff[leaf]]
+    return out
 
 
 def _tree_to_dict(tree: Tree) -> Dict:
@@ -112,6 +119,12 @@ def _tree_from_dict(d: Dict) -> Tree:
     leaf_count = np.zeros(max(num_leaves, 1), np.int64)
     cat_boundaries: List[int] = [0]
     cat_words: List[np.ndarray] = []
+    leaf_const = np.zeros(max(num_leaves, 1), np.float64)
+    leaf_features: List[np.ndarray] = [np.zeros(0, np.int32)
+                                       for _ in range(max(num_leaves, 1))]
+    leaf_coeff: List[np.ndarray] = [np.zeros(0, np.float64)
+                                    for _ in range(max(num_leaves, 1))]
+    has_linear = [False]
 
     def child_index(node: Dict) -> int:
         return int(node["split_index"]) if "split_index" in node \
@@ -122,6 +135,13 @@ def _tree_from_dict(d: Dict) -> Tree:
             leaf = int(node.get("leaf_index", 0))
             leaf_value[leaf] = float(node["leaf_value"])
             leaf_count[leaf] = int(node.get("leaf_count", 0))
+            if node.get("leaf_features"):
+                has_linear[0] = True
+                leaf_const[leaf] = float(node.get("leaf_const", 0.0))
+                leaf_features[leaf] = np.asarray(node["leaf_features"],
+                                                 np.int32)
+                leaf_coeff[leaf] = np.asarray(
+                    node.get("leaf_coeff", []), np.float64)
             return
         i = int(node["split_index"])
         split_feature[i] = int(node["split_feature"])
@@ -172,6 +192,9 @@ def _tree_from_dict(d: Dict) -> Tree:
         if has_cat else None,
         cat_threshold=np.concatenate(cat_words).astype(np.uint32)
         if has_cat else None,
+        leaf_features=leaf_features if has_linear[0] else None,
+        leaf_coeff=leaf_coeff if has_linear[0] else None,
+        leaf_const=leaf_const if has_linear[0] else None,
     )
 
 

@@ -35,6 +35,17 @@ def _tree_to_proto(t: Tree, msg) -> None:
     if num_cat > 0:
         msg.cat_boundaries.extend(int(v) for v in t.cat_boundaries)
         msg.cat_threshold.extend(int(v) for v in t.cat_threshold)
+    if t.leaf_features is not None:
+        # linear leaves: flattened pools + per-leaf counts (proto fields
+        # 16-20; doubles are wire-exact, so the round trip is bit-exact)
+        msg.is_linear = True
+        msg.leaf_const.extend(float(v) for v in t.leaf_const[: t.num_leaves])
+        msg.leaf_num_features.extend(
+            len(f) for f in t.leaf_features[: t.num_leaves])
+        msg.leaf_features.extend(
+            int(v) for f in t.leaf_features[: t.num_leaves] for v in f)
+        msg.leaf_coeff.extend(
+            float(v) for c in t.leaf_coeff[: t.num_leaves] for v in c)
     msg.shrinkage = float(t.shrinkage)
 
 
@@ -69,6 +80,17 @@ def _tree_from_proto(msg) -> Tree:
     if msg.num_cat > 0:
         tree.cat_boundaries = np.array(msg.cat_boundaries, dtype=np.int32)
         tree.cat_threshold = np.array(msg.cat_threshold, dtype=np.uint32)
+    if msg.is_linear:
+        flat_f = np.array(msg.leaf_features, dtype=np.int32)
+        flat_c = np.array(msg.leaf_coeff, dtype=np.float64)
+        feats, coeffs, off = [], [], 0
+        for k in msg.leaf_num_features:
+            feats.append(flat_f[off: off + k])
+            coeffs.append(flat_c[off: off + k])
+            off += int(k)
+        tree.leaf_features = feats
+        tree.leaf_coeff = coeffs
+        tree.leaf_const = np.array(msg.leaf_const, dtype=np.float64)
     return tree
 
 

@@ -17,8 +17,16 @@ from ..utils.log import Log
 
 
 def _fmt_double(v: float) -> str:
-    return np.format_float_positional(v, precision=17, trim="0", unique=True) \
-        if np.isfinite(v) else repr(float(v))
+    if not np.isfinite(v):
+        return repr(float(v))
+    s = np.format_float_positional(v, precision=17, trim="0", unique=True)
+    if float(s) == float(v):
+        return s
+    # positional precision counts FRACTIONAL digits, so small magnitudes
+    # with long mantissas (|v| < ~1e-3, e.g. linear-leaf coefficients, or
+    # the -1e-20 zero-boundary threshold) silently truncate — fall back to
+    # the exact scientific form (the reference's %.17g does the same)
+    return np.format_float_scientific(v, trim="0", unique=True)
 
 
 def _arr_str(arr, fmt=str) -> str:
@@ -45,6 +53,21 @@ def _tree_to_string(tree: Tree) -> str:
     if num_cat > 0:
         lines.append("cat_boundaries=" + _arr_str(tree.cat_boundaries))
         lines.append("cat_threshold=" + _arr_str(tree.cat_threshold))
+    if tree.leaf_features is not None:
+        # piecewise-linear leaves — the later-LightGBM linear_tree block
+        # (src/io/tree.cpp Tree::ToString is_linear path): per-leaf counts
+        # unflatten the feature/coefficient pools; 17-digit doubles keep
+        # the round trip bit-exact like every other float field here
+        L = tree.num_leaves
+        lines.append("is_linear=1")
+        lines.append("leaf_const=" + _arr_str(tree.leaf_const[:L],
+                                              _fmt_double))
+        lines.append("num_features=" + _arr_str(
+            [len(f) for f in tree.leaf_features[:L]]))
+        lines.append("leaf_features=" + _arr_str(
+            [v for f in tree.leaf_features[:L] for v in f]))
+        lines.append("leaf_coeff=" + _arr_str(
+            [v for c in tree.leaf_coeff[:L] for v in c], _fmt_double))
     lines.append(f"shrinkage={_fmt_double(tree.shrinkage)}")
     lines.append("")
     return "\n".join(lines)
@@ -202,6 +225,19 @@ def _parse_tree_block(lines: Dict[str, str]) -> Tree:
         tree.cat_boundaries = ints("cat_boundaries", num_cat + 1).astype(np.int32)
         nthr = int(tree.cat_boundaries[-1])
         tree.cat_threshold = ints("cat_threshold", nthr).astype(np.uint32)
+    if int(lines.get("is_linear", "0")):
+        nf = ints("num_features", num_leaves).astype(np.int64)
+        total = int(nf.sum())
+        flat_f = ints("leaf_features", total).astype(np.int32)
+        flat_c = floats("leaf_coeff", total)
+        feats, coeffs, off = [], [], 0
+        for k in nf:
+            feats.append(flat_f[off: off + k])
+            coeffs.append(flat_c[off: off + k])
+            off += int(k)
+        tree.leaf_features = feats
+        tree.leaf_coeff = coeffs
+        tree.leaf_const = floats("leaf_const", num_leaves)
     return tree
 
 

@@ -66,6 +66,15 @@ def model_to_pmml(model, name: str = "lightgbm_tpu") -> str:
     from ..basic import Booster
     if isinstance(model, str):
         model = Booster(model_file=model)
+    if any(t.is_linear for t in model.trees):
+        # PMML TreeModel nodes carry one scalar score: a per-leaf linear
+        # model would need a nested RegressionModel per leaf segment —
+        # reject LOUDLY rather than export constants that silently drop
+        # the linear terms (use protobuf/text/JSON, or codegen, instead)
+        raise ValueError(
+            "PMML export does not support linear-tree models "
+            "(linear_tree=true): TreeModel leaves are scalar scores. "
+            "Export via protobuf/text/JSON, or C++ codegen.")
 
     feature_names = model.feature_name()
     pmml = ET.Element("PMML", version="4.2",

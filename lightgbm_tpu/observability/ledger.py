@@ -89,7 +89,8 @@ def normalize_bench(payload: Optional[Dict], source: str,
                "platform": None, "rows": None, "kernel": None,
                "n_devices": None, "residency": None, "tree_batch": None,
                "auc": None, "serve": None, "serve_chaos": None,
-               "bundle": None, "shed_rate": None, "p99_ms": None,
+               "bundle": None, "linear": None, "shed_rate": None,
+               "p99_ms": None,
                "recompiles_post_warmup": None, "host_syncs": None,
                "steady_s_per_iter": None, "hbm_peak_gb": None,
                "cost": None, "error": None}
@@ -98,7 +99,7 @@ def normalize_bench(payload: Optional[Dict], source: str,
         return e
     for k in ("value", "unit", "vs_baseline", "platform", "rows", "kernel",
               "n_devices", "residency", "tree_batch", "auc", "serve",
-              "serve_chaos", "bundle", "shed_rate",
+              "serve_chaos", "bundle", "linear", "shed_rate",
               "p99_ms", "recompiles_post_warmup", "hbm_peak_gb", "error"):
         if payload.get(k) is not None:
             e[k] = payload[k]
@@ -159,6 +160,7 @@ def load_history(root: str) -> List[Dict]:
                       ("SERVE_r*.json", normalize_bench),
                       ("SERVE_CHAOS_r*.json", normalize_bench),
                       ("SPARSE_r*.json", normalize_bench),
+                      ("LINEAR_r*.json", normalize_bench),
                       ("MULTICHIP_r*.json", normalize_multichip)):
         for path in sorted(glob.glob(os.path.join(root, pat))):
             entries.append(norm(payload_of(path), os.path.basename(path),
@@ -194,13 +196,17 @@ def comparability_key(e: Dict) -> str:
     SPARSE_r*.json) additionally key on the EFB representation
     (``bundle="bundlespace"``): the bundle-space, legacy-unpack, and
     no-EFB arms deliberately trade throughput against memory layout, so a
-    sparse arm is never judged cross-representation. Fields absent on
-    older history are None — those entries keep comparing among
-    themselves."""
+    sparse arm is never judged cross-representation. Linear-leaf results
+    (``bench.py --linear``, LINEAR_r*.json) key on the leaf model
+    (``linear="linear"``): a per-leaf ridge-solve workload pays the fit
+    leg by design and must never be judged against constant-leaf
+    throughput. Fields absent on older history are None — those entries
+    keep comparing among themselves."""
     return (f"platform={e.get('platform')}|rows={e.get('rows')}"
             f"|kernel={e.get('kernel')}|n_devices={e.get('n_devices')}"
             f"|residency={e.get('residency')}|serve={e.get('serve')}"
-            f"|serve_chaos={e.get('serve_chaos')}|bundle={e.get('bundle')}")
+            f"|serve_chaos={e.get('serve_chaos')}|bundle={e.get('bundle')}"
+            f"|linear={e.get('linear')}")
 
 
 def multichip_key(e: Dict) -> str:

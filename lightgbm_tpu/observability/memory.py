@@ -116,7 +116,8 @@ def estimate_wave_residency(*, rows: int, cols: int, code_itemsize: int,
                             has_weight: bool = False, tree_batch: int = 1,
                             compensated: bool = False,
                             valid_bytes: int = 0,
-                            stream_shard_bytes: int = 0) -> Dict:
+                            stream_shard_bytes: int = 0,
+                            linear_max_features: int = 0) -> Dict:
     """Per-device HBM residency of one training step, by component (bytes).
 
     ``rows``/``cols`` are the PADDED per-device dims the step actually
@@ -140,6 +141,11 @@ def estimate_wave_residency(*, rows: int, cols: int, code_itemsize: int,
                   the [F, B, S*ch] f32 accumulator (x2 Kahan-compensated)
     - trees:      stacked per-batch tree outputs (small)
     - valid:      attached validation sets (codes + scores), if any
+    - linear:     linear_tree=true only (``linear_max_features`` > 0): the
+                  device-resident raw f32 slice + missing plane
+                  ([N, F] x 5 B), the per-leaf moment buffers
+                  ([L+1, K+1, K+1] + [L+1, K+1] f32), and the chunked
+                  one-hot gather intermediate of the fit leg
     """
     f32 = 4
     comp = {}
@@ -160,6 +166,15 @@ def estimate_wave_residency(*, rows: int, cols: int, code_itemsize: int,
                 + 13 * (num_leaves + 1) * f32)          # node/leaf arrays
     comp["trees"] = max(1, tree_batch) * num_models * per_tree
     comp["valid"] = valid_bytes
+    comp["linear"] = 0
+    if linear_max_features > 0:
+        K1 = linear_max_features + 1
+        lin_chunk = min(chunk_rows, 8192)
+        comp["linear"] = (
+            rows * cols * (f32 + 1)                    # raw slice + missing
+            + (num_leaves + 1) * (K1 * K1 + K1 + 1) * f32   # moments
+            + lin_chunk * linear_max_features * cols * f32  # one-hot gather
+            + lin_chunk * (K1 * K1 + K1 + 1) * f32)         # channel matrix
     total = int(sum(comp.values()))
     return {"components": {k: int(v) for k, v in comp.items()},
             "total_bytes": total,
@@ -224,6 +239,9 @@ def hbm_preflight(gbdt) -> Dict:
         valid_bytes += int(vs.Xb.shape[0]) * (
             int(vs.Xb.shape[1]) * int(np.dtype(vs.Xb.dtype).itemsize)
             + gbdt.num_models * 4)
+        if getattr(vs, "Xraw", None) is not None:
+            # linear_tree: the valid raw slice (f32) + missing plane (bool)
+            valid_bytes += int(vs.Xraw.shape[0]) * int(vs.Xraw.shape[1]) * 5
     dims = dict(rows=rows, cols=cols, code_itemsize=code_itemsize,
                 num_models=gbdt.num_models, num_leaves=spec.num_leaves,
                 hist_cols=hist_cols, hist_bins=B_hist,
@@ -238,7 +256,10 @@ def hbm_preflight(gbdt) -> Dict:
                 has_weight=gbdt.weight is not None,
                 tree_batch=int(getattr(gbdt, "tree_batch", 1)),
                 compensated=spec.hist_f64, valid_bytes=valid_bytes,
-                stream_shard_bytes=stream_shard_bytes)
+                stream_shard_bytes=stream_shard_bytes,
+                linear_max_features=(
+                    int(getattr(gbdt.config, "linear_max_features", 0))
+                    if getattr(gbdt, "linear_tree", False) else 0))
     est = estimate_wave_residency(**dims)
     est["dims"] = dims
     est["residency"] = residency

@@ -1,0 +1,320 @@
+"""Piecewise-linear leaves: per-leaf ridge solves inside the training step.
+
+The `linear_tree=true` workload of PAPERS.md "Gradient Boosting With
+Piece-Wise Linear Regression Trees" (arXiv 1802.05640): after a tree's
+structure is grown, every leaf fits a linear model over the numerical
+features on its root-to-leaf PATH (bounded by ``linear_max_features``)
+instead of a single constant. The fit minimizes the same second-order
+objective the constant leaf does,
+
+    sum_r [ g_r * f(x_r) + 1/2 h_r * f(x_r)^2 ] + 1/2 lambda |beta|^2
+
+whose normal equations are ``(X^T H X + lambda I) beta = -X^T g`` with
+``X = [1, x_f1, .., x_fK]`` over the leaf's rows — so the per-leaf
+Gram/moment matrices accumulate with EXACTLY the histogram build's
+chunked segment-sum shape (ops/histogram.py: a one-hot leaf matmul over
+row chunks), and all leaves solve at once with one batched Cholesky.
+Everything here is traced inside the training step (boosting/gbdt.py
+``step_body``): zero extra dispatches, zero host syncs, 0 recompiles in
+steady state, and ``tree_batch`` fusion keeps working because the fit is
+ordinary traced math.
+
+Reference semantics (later-LightGBM ``linear_tree``,
+src/treelearner/linear_tree_learner.cpp CalculateLinear):
+
+- rows with a missing value (NaN) in ANY of the leaf's features are
+  excluded from the normal equations and predict through the leaf's
+  CONSTANT output (``leaf_value``) — zeros stay numeric;
+- a leaf degrades LOUDLY to its constant output when a categorical split
+  sits on its path, when it has no numerical path features, when fewer
+  (included, non-missing) rows than coefficients remain, or when the
+  Cholesky factorization is not finite (ill-conditioned Gram) — the
+  degraded leaf serializes with an empty feature list, never silently
+  wrong coefficients;
+- shrinkage scales the intercept and every coefficient exactly like the
+  constant leaf value (Tree::Shrinkage).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import table_lookup
+
+
+def linear_chunk_rows(chunk_rows: int, cap: int = 8192) -> int:
+    """Row-chunk length of the moment accumulation: the largest divisor of
+    the histogram chunk that is <= ``cap``, so every padded row count the
+    wave loop accepts (a chunk multiple) also divides the linear pass.
+    The [R, K, F] one-hot gather intermediate scales with the chunk, so
+    the linear leg runs smaller chunks than the histogram matmul."""
+    c = min(chunk_rows, cap)
+    while chunk_rows % c:
+        c -= 1
+    return max(c, 1)
+
+
+def leaf_path_features(tree, is_cat: jnp.ndarray, max_features: int,
+                       max_steps: int
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-leaf path features from the device TreeArrays.
+
+    Walks each leaf toward the root (``max_steps`` bounds the depth),
+    collecting the first ``max_features`` DISTINCT numerical split
+    features in leaf-to-root order — the nearest splits are the most
+    leaf-relevant, matching the reference's path-feature collection.
+
+    Returns ``(leaf_feat [L+1, K] i32, -1-padded; has_cat [L+1] bool;
+    nfeat [L+1] i32)``. A categorical split anywhere on the path flags
+    ``has_cat`` — the solve degrades that leaf to its constant output.
+    """
+    L1 = tree.leaf_value.shape[0]                    # L + 1 (scratch row)
+    M1 = tree.left_child.shape[0]                    # M + 1
+    K = max_features
+    iota_k = jnp.arange(K, dtype=jnp.int32)[None, :]
+
+    # parent of each internal node, by scattering the child links
+    # (leaf_parent only covers leaves); children < 0 encode leaves ~c
+    node_iota = jnp.arange(M1, dtype=jnp.int32)
+    node_parent = jnp.full(M1, -1, jnp.int32)
+    lc, rc = tree.left_child, tree.right_child
+    node_parent = node_parent.at[
+        jnp.where(lc >= 0, lc, M1)].set(node_iota, mode="drop")
+    node_parent = node_parent.at[
+        jnp.where(rc >= 0, rc, M1)].set(node_iota, mode="drop")
+
+    sf = tree.split_feature
+    node_is_cat = tree.is_cat | is_cat[jnp.clip(sf, 0, is_cat.shape[0] - 1)]
+
+    def body(_i, carry):
+        node, feats, nfeat, has_cat = carry
+        valid = node >= 0
+        nid = jnp.maximum(node, 0)
+        f = sf[nid]
+        c = node_is_cat[nid]
+        has_cat = has_cat | (valid & c)
+        seen = jnp.any(feats == f[:, None], axis=1)
+        add = valid & ~c & ~seen & (nfeat < K)
+        feats = jnp.where(add[:, None] & (iota_k == nfeat[:, None]),
+                          f[:, None], feats)
+        nfeat = nfeat + add.astype(jnp.int32)
+        node = jnp.where(valid, node_parent[nid], -1)
+        return node, feats, nfeat, has_cat
+
+    node0 = tree.leaf_parent[:L1]
+    feats0 = jnp.full((L1, K), -1, jnp.int32)
+    nfeat0 = jnp.zeros(L1, jnp.int32)
+    has_cat0 = jnp.zeros(L1, bool)
+    _, feats, nfeat, has_cat = jax.lax.fori_loop(
+        0, max_steps, body, (node0, feats0, nfeat0, has_cat0))
+    return feats, has_cat, nfeat
+
+
+def _gather_leaf_values(Xraw: jnp.ndarray, Xmiss: jnp.ndarray,
+                        feats: jnp.ndarray
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Raw value + missing flag of each row's K leaf features.
+
+    ``feats`` is [R, K] (-1 = unused slot). The gather is the grower's
+    one-hot multiply-sum idiom over the F lanes (_route_rows) — a fused
+    VPU stream, no per-row table gather; ``Xraw`` is NaN-sanitized at
+    placement (boosting/gbdt.py) so 0 * sanitized value never poisons
+    the sum, and missingness rides the separate ``Xmiss`` plane. Unused
+    slots (-1) match no lane: value 0, not missing.
+    """
+    iota_f = jnp.arange(Xraw.shape[1], dtype=jnp.int32)[None, None, :]
+    onehot = (feats[:, :, None] == iota_f)                    # [R, K, F]
+    vals = jnp.sum(jnp.where(onehot, Xraw[:, None, :], 0.0), axis=2)
+    miss = jnp.any(onehot & Xmiss[:, None, :], axis=2)
+    return vals, miss
+
+
+def accumulate_leaf_moments(Xraw, Xmiss, leaf_id, leaf_feat, g, h, included,
+                            chunk_rows: int):
+    """Per-leaf normal-equation moments, chunked like the histogram build.
+
+    Returns ``(XTHX [L+1, K+1, K+1], XTg [L+1, K+1], cnt [L+1])`` where
+    the design row is ``z = [1, x_f1 .. x_fK]`` and rows with a missing
+    value in any leaf feature (or excluded by the bagging/padding mask)
+    contribute nothing. One ``[R, L+1] x [R, C]`` one-hot contraction per
+    chunk — the same segmented-reduction shape as ops/histogram.py — at
+    Precision.HIGHEST (exact products; the one-hot side is 0/1).
+    """
+    N = Xraw.shape[0]
+    L1, K = leaf_feat.shape
+    K1 = K + 1
+    assert N % chunk_rows == 0, (N, chunk_rows)
+    n_chunks = N // chunk_rows
+    leaf_iota = jnp.arange(L1, dtype=jnp.int32)[None, :]
+
+    def chunk_part(i):
+        sl = jax.lax.dynamic_slice_in_dim
+        lo = i * chunk_rows
+        lid = sl(leaf_id, lo, chunk_rows)
+        xr = sl(Xraw, lo, chunk_rows)
+        xm = sl(Xmiss, lo, chunk_rows)
+        gc = sl(g, lo, chunk_rows)
+        hc = sl(h, lo, chunk_rows)
+        mc = sl(included, lo, chunk_rows)
+        feats = table_lookup(lid, leaf_feat)                   # [R, K]
+        vals, miss = _gather_leaf_values(xr, xm, feats)        # [R, K]
+        w = mc * (~jnp.any(miss, axis=1)).astype(jnp.float32)  # [R]
+        z = jnp.concatenate(
+            [jnp.ones((chunk_rows, 1), jnp.float32), vals], axis=1)
+        outer = (z[:, :, None] * z[:, None, :]).reshape(chunk_rows, K1 * K1)
+        ch = jnp.concatenate(
+            [outer * (hc * w)[:, None], z * (gc * w)[:, None], w[:, None]],
+            axis=1)                                            # [R, C]
+        onehot = (lid[:, None] == leaf_iota).astype(jnp.float32)
+        return jax.lax.dot_general(
+            onehot, ch, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)               # [L+1, C]
+
+    acc0 = jnp.zeros((L1, K1 * K1 + K1 + 1), jnp.float32)
+    acc, _ = jax.lax.scan(lambda a, i: (a + chunk_part(i), ()), acc0,
+                          jnp.arange(n_chunks))
+    XTHX = acc[:, : K1 * K1].reshape(L1, K1, K1)
+    XTg = acc[:, K1 * K1: K1 * K1 + K1]
+    cnt = acc[:, -1]
+    return XTHX, XTg, cnt
+
+
+def solve_leaf_models(XTHX, XTg, leaf_feat, nfeat, has_cat, cnt,
+                      linear_lambda: float):
+    """Batched ridge solve: ``(XTHX + lambda I) beta = -XTg`` for every
+    leaf at once via one vmapped Cholesky (bit-reproducible — no pivoting,
+    fixed operation order).
+
+    Unused design dims (slot padding beyond ``nfeat``) carry an identity
+    diagonal so the factorization stays well-posed; the ridge term applies
+    to coefficient dims only, never the intercept. A leaf is LINEAR iff it
+    has >= 1 numerical path feature, no categorical split on the path, at
+    least ``nfeat + 2`` fitted rows, and a finite solve — everything else
+    degrades to the constant leaf (empty feature list, zero coefficients).
+
+    Returns ``(leaf_const [L+1] f32, leaf_coeff [L+1, K] f32,
+    leaf_feat' [L+1, K] i32, n_degraded i32)``.
+    """
+    L1, K1, _ = XTHX.shape
+    K = K1 - 1
+    iota1 = jnp.arange(K1, dtype=jnp.int32)[None, :]
+    # dim 0 = intercept; dims 1..K real iff slot < nfeat
+    dim_real = iota1 <= nfeat[:, None]                        # [L+1, K1]
+    diag_add = jnp.where(
+        dim_real, jnp.where(iota1 > 0, jnp.float32(linear_lambda), 0.0),
+        1.0)
+    A = XTHX + jax.vmap(jnp.diag)(diag_add)
+    # zero any stray mass in padded rows/cols (identity block must be pure)
+    pad2 = (~dim_real)[:, :, None] | (~dim_real)[:, None, :]
+    A = jnp.where(pad2 & ~jax.vmap(jnp.diag)(jnp.ones((L1, K1), bool)),
+                  0.0, A)
+    b = -XTg * dim_real.astype(jnp.float32)
+    chol = jnp.linalg.cholesky(A)
+    y = jax.lax.linalg.triangular_solve(
+        chol, b[:, :, None], left_side=True, lower=True)
+    beta = jax.lax.linalg.triangular_solve(
+        chol, y, left_side=True, lower=True, transpose_a=True)[:, :, 0]
+    solvable = jnp.all(jnp.isfinite(beta), axis=1) \
+        & jnp.all(jnp.isfinite(chol[:, jnp.arange(K1), jnp.arange(K1)]),
+                  axis=1)
+    fittable = (nfeat > 0) & ~has_cat
+    ok = fittable & solvable & (cnt >= (nfeat + 2).astype(jnp.float32))
+    leaf_const = jnp.where(ok, beta[:, 0], 0.0).astype(jnp.float32)
+    leaf_coeff = jnp.where(ok[:, None] & dim_real[:, 1:], beta[:, 1:],
+                           0.0).astype(jnp.float32)
+    leaf_feat_out = jnp.where(ok[:, None], leaf_feat, -1)
+    n_degraded = jnp.sum((fittable & ~ok).astype(jnp.int32))
+    return leaf_const, leaf_coeff, leaf_feat_out, n_degraded
+
+
+def fit_linear_leaves(tree, Xraw, Xmiss, leaf_id, g, h, included, is_cat,
+                      *, max_features: int, linear_lambda: float,
+                      chunk_rows: int, max_steps: int):
+    """The whole fit: path features -> chunked moments -> batched Cholesky.
+
+    Traced inside the training step right after ``grow_tree`` (before
+    shrinkage, so the coefficients scale with the constant exactly like
+    the reference's Tree::Shrinkage). Returns the tree with
+    ``leaf_feat``/``leaf_coeff``/``leaf_const`` populated; degraded
+    leaves keep an empty feature list and serve their constant output.
+    """
+    leaf_feat, has_cat, nfeat = leaf_path_features(
+        tree, is_cat, max_features, max_steps)
+    lin_chunk = linear_chunk_rows(chunk_rows)
+    XTHX, XTg, cnt = accumulate_leaf_moments(
+        Xraw, Xmiss, leaf_id, leaf_feat, g, h, included, lin_chunk)
+    leaf_const, leaf_coeff, leaf_feat, _n_deg = solve_leaf_models(
+        XTHX, XTg, leaf_feat, nfeat, has_cat, cnt, linear_lambda)
+    # scratch row (leaf L) stays inert: table_lookup reads every table row
+    # with weight 0 and 0 * garbage must stay 0
+    L = tree.leaf_value.shape[0] - 1
+    leaf_const = leaf_const.at[L].set(0.0)
+    leaf_coeff = leaf_coeff.at[L].set(0.0)
+    leaf_feat = leaf_feat.at[L].set(-1)
+    return tree._replace(leaf_feat=leaf_feat, leaf_coeff=leaf_coeff,
+                         leaf_const=leaf_const)
+
+
+def linear_leaf_scores(tree, leaf_id, Xraw, Xmiss) -> jnp.ndarray:
+    """Per-row leaf OUTPUT of a linear tree (f32, device) — the score-update
+    epilogue shared by the train rows and every valid set: rows in a linear
+    leaf with all features present get ``const + sum_k coeff_k * x_k``,
+    everything else (constant leaf, degraded leaf, missing feature) the
+    constant ``leaf_value`` — the reference's NaN fallback.
+    """
+    K = tree.leaf_feat.shape[1]
+    packed = table_lookup(
+        leaf_id,
+        jnp.concatenate([tree.leaf_value[:, None], tree.leaf_const[:, None],
+                         tree.leaf_coeff], axis=1))            # [N, 2+K]
+    feats = table_lookup(leaf_id, tree.leaf_feat)              # [N, K]
+    vals, miss = _gather_leaf_values(Xraw, Xmiss, feats)
+    lin = (feats[:, 0] >= 0) & ~jnp.any(miss, axis=1)
+    acc = packed[:, 1] + jnp.sum(packed[:, 2:] * vals, axis=1)
+    return jnp.where(lin, acc, packed[:, 0])
+
+
+def linear_cost_report(n_rows: int, num_features: int, num_leaves: int,
+                       max_features: int, chunk_rows: int,
+                       site: Optional[str] = None) -> dict:
+    """Compile-time cost probe of the standalone linear-fit leg at one
+    shape class (the twin of histogram.histogram_cost_report): lower +
+    compile a jitted moment-accumulation + solve on zero inputs and
+    publish FLOPs/bytes/HBM as ``cost.<site>.*`` — the solve leg's entry
+    in the cost-capture site list so the drift gate covers it. In
+    production the fit is fused into the train step; its isolated cost is
+    only observable here. Explicit call = intent (ignores the
+    ``costs.enabled()`` gate)."""
+    from ..observability import costs as obs_costs
+    lin_chunk = linear_chunk_rows(chunk_rows)
+    n_rows = ((n_rows + lin_chunk - 1) // lin_chunk) * lin_chunk
+    L1 = num_leaves + 1
+    Xraw = jnp.zeros((n_rows, num_features), jnp.float32)
+    Xmiss = jnp.zeros((n_rows, num_features), bool)
+    lid = jnp.zeros(n_rows, jnp.int32)
+    leaf_feat = jnp.full((L1, max_features), -1, jnp.int32)
+    zf = jnp.zeros(n_rows, jnp.float32)
+    nfeat = jnp.zeros(L1, jnp.int32)
+    has_cat = jnp.zeros(L1, bool)
+
+    def run(Xraw, Xmiss, lid, leaf_feat, g, h, inc, nfeat, has_cat):
+        XTHX, XTg, cnt = accumulate_leaf_moments(
+            Xraw, Xmiss, lid, leaf_feat, g, h, inc, lin_chunk)
+        return solve_leaf_models(XTHX, XTg, leaf_feat, nfeat, has_cat, cnt,
+                                 0.0)[:3]
+
+    site = site or f"linear.fit.k{max_features}"
+    dims = dict(rows=int(n_rows), features=int(num_features),
+                num_leaves=int(num_leaves),
+                max_features=int(max_features), chunk_rows=int(lin_chunk))
+    try:
+        compiled = jax.jit(run).lower(Xraw, Xmiss, lid, leaf_feat, zf, zf,
+                                      zf, nfeat, has_cat).compile()
+        rep = obs_costs.report_from_compiled(compiled, site, dims)
+    except Exception as e:                                   # noqa: BLE001
+        rep = dict(dims, site=site, error=f"{type(e).__name__}: {e}"[:300])
+    obs_costs.publish(rep)
+    return rep

@@ -121,6 +121,28 @@ class StackedForest:
         self.max_leaves = L
         self.has_categorical = any(
             (np.asarray(t.decision_type) & 1).any() for t in trees)
+        # piecewise-linear leaves (linear_tree models): stacked -1-padded
+        # (feature, coefficient) tables for the leaf-local dot-product
+        # epilogue of forest_walk_linear; None when every leaf is constant
+        self.has_linear = any(t.is_linear for t in trees)
+        self.max_leaf_features = 0
+        self.leaf_const32 = self.leaf_coeff32 = self.leaf_feat = None
+        if self.has_linear:
+            Kf = max(max((len(f) for f in t.leaf_features), default=0)
+                     for t in trees if t.leaf_features is not None)
+            self.max_leaf_features = Kf = max(Kf, 1)
+            self.leaf_const32 = np.zeros((T, L), np.float32)
+            self.leaf_coeff32 = np.zeros((T, L, Kf), np.float32)
+            self.leaf_feat = np.full((T, L, Kf), -1, np.int32)
+            for i, t in enumerate(trees):
+                if t.leaf_features is None:
+                    continue
+                self.leaf_const32[i, : len(t.leaf_const)] = t.leaf_const
+                for li, feats in enumerate(t.leaf_features):
+                    k = len(feats)
+                    if k:
+                        self.leaf_feat[i, li, :k] = feats
+                        self.leaf_coeff32[i, li, :k] = t.leaf_coeff[li]
 
         split_feature = np.zeros((T, M), np.int32)
         thr_rank = np.zeros((T, M), np.int32)
@@ -322,6 +344,54 @@ def forest_walk_leaves(split_feature, thr_rank, decision, left, right,
     return -cur - 1                                                # [N, T]
 
 
+def forest_walk_linear(split_feature, thr_rank, decision, left, right,
+                       leaf_value, leaf_const, leaf_coeff, leaf_feat,
+                       root_is_leaf, zero_rank, codes, is_nan, is_zero,
+                       raw, raw_nan):
+    """Per-(row, tree) leaf OUTPUT [N, T] f32 for a linear-leaf forest:
+    the integer-exact ``forest_walk_leaves`` traversal plus a leaf-local
+    dot-product epilogue over the device-resident raw-feature slice
+    (``raw`` NaN-sanitized f32 [N, F]; ``raw_nan`` its missing plane).
+    Rows missing any leaf feature take the constant ``leaf_value`` —
+    exactly the host predictor's fallback semantics."""
+    T = split_feature.shape[0]
+    N = codes.shape[0]
+    Kf = leaf_feat.shape[2]
+    t_iota = jnp.arange(T, dtype=jnp.int32)[None, :]
+    leaves = forest_walk_leaves(split_feature, thr_rank, decision, left,
+                                right, root_is_leaf, zero_rank, codes,
+                                is_nan, is_zero)               # [N, T]
+    feats = leaf_feat[t_iota, leaves]                          # [N, T, Kf]
+    coeff = leaf_coeff[t_iota, leaves]
+    const = leaf_const[t_iota, leaves]
+    base = leaf_value[t_iota, leaves]
+    # raw value + missing flag per (row, tree, k): a flat per-row gather
+    # over the F axis (feats are -1 for unused slots -> clipped index 0,
+    # masked out below)
+    idx = jnp.maximum(feats, 0).reshape(N, T * Kf)
+    vals = jnp.take_along_axis(raw, idx, axis=1).reshape(N, T, Kf)
+    miss = jnp.take_along_axis(raw_nan, idx, axis=1).reshape(N, T, Kf)
+    used = feats >= 0
+    vals = jnp.where(used, vals, 0.0)
+    miss = miss & used
+    lin = used[..., 0] & ~jnp.any(miss, axis=2)                # [N, T]
+    acc = const + jnp.sum(coeff * vals, axis=2)
+    return jnp.where(lin, acc, base)                           # [N, T]
+
+
+@jax.jit
+def _forest_walk_linear_sum(split_feature, thr_rank, decision, left, right,
+                            leaf_value, leaf_const, leaf_coeff, leaf_feat,
+                            root_is_leaf, zero_rank, codes, is_nan, is_zero,
+                            raw, raw_nan):
+    """f32 device sum over trees of ``forest_walk_linear`` — the linear
+    twin of ``_forest_walk`` for the training-side batch-predict entry."""
+    return jnp.sum(forest_walk_linear(
+        split_feature, thr_rank, decision, left, right, leaf_value,
+        leaf_const, leaf_coeff, leaf_feat, root_is_leaf, zero_rank,
+        codes, is_nan, is_zero, raw, raw_nan), axis=1)
+
+
 @jax.jit
 def _forest_walk(split_feature, thr_rank, decision, left, right, leaf_value,
                  root_is_leaf, zero_rank, codes, is_nan, is_zero):
@@ -367,31 +437,50 @@ def forest_predict_raw(trees, X: np.ndarray, num_features: int,
             out += t.predict(Xh)
         return out
     out = np.zeros(X.shape[0], np.float64)
-    dev = [jnp.asarray(a) for a in
-           (forest.split_feature, forest.thr_rank, forest.decision,
-            forest.left, forest.right, forest.leaf_value, forest.root_is_leaf,
-            forest.zero_rank)]
+    linear = forest.has_linear
+    if linear:
+        dev = [jnp.asarray(a) for a in
+               (forest.split_feature, forest.thr_rank, forest.decision,
+                forest.left, forest.right, forest.leaf_value,
+                forest.leaf_const32, forest.leaf_coeff32, forest.leaf_feat,
+                forest.root_is_leaf, forest.zero_rank)]
+        walk = _forest_walk_linear_sum
+    else:
+        dev = [jnp.asarray(a) for a in
+               (forest.split_feature, forest.thr_rank, forest.decision,
+                forest.left, forest.right, forest.leaf_value,
+                forest.root_is_leaf, forest.zero_rank)]
+        walk = _forest_walk
     for lo in range(0, X.shape[0], chunk_rows):
         chunk = np.asarray(X[lo:lo + chunk_rows], np.float64)
         codes, is_nan, is_zero = forest.encode_rows(chunk)
         args = (*dev, jnp.asarray(codes), jnp.asarray(is_nan),
                 jnp.asarray(is_zero))
+        if linear:
+            # the leaf-local dot-product epilogue reads raw f32 values —
+            # sanitized (NaN -> 0) with the missing plane alongside, so the
+            # 0-weight lanes of the gather can never poison the sum
+            raw32 = chunk.astype(np.float32)
+            raw_nan = np.isnan(raw32)
+            np.nan_to_num(raw32, copy=False, nan=0.0)
+            args = args + (jnp.asarray(raw32), jnp.asarray(raw_nan))
         if lo == 0:
             # cost-report leg of the predict dispatch (observability/costs):
             # compile-time capture of the first chunk's signature, once
             from ..observability import costs as obs_costs
             if obs_costs.enabled():
-                # _forest_walk is ONE module-level jit serving every forest:
+                # the walk is ONE module-level jit serving every forest:
                 # the fingerprint makes a different forest/batch shape
                 # re-capture instead of serving the first model's numbers
                 obs_costs.capture_jit(
-                    "predict.forest_walk", _forest_walk, args,
+                    "predict.forest_walk" + (".linear" if linear else ""),
+                    walk, args,
                     dims=dict(rows=int(codes.shape[0]),
                               trees=int(forest.num_trees)),
                     fingerprint=(int(codes.shape[0]), codes.shape[1],
                                  int(forest.num_trees),
-                                 int(forest.max_leaves)))
+                                 int(forest.max_leaves), linear))
         # host boundary: predict RETURNS numpy — the sync is the contract
         out[lo:lo + chunk_rows] = np.asarray(  # tpu-lint: disable=R002
-            _forest_walk(*args))
+            walk(*args))
     return out

@@ -111,6 +111,10 @@ VOLATILE_CONFIG_FIELDS = frozenset({
     "serve_max_batch_rows", "serve_max_wait_ms", "serve_buckets",
     "serve_max_queue_rows", "serve_deadline_ms", "serve_breaker_failures",
     "serve_breaker_window_s", "serve_probe_interval_s",
+    # linear-tree loudness knob (config.py): warning cadence only — the
+    # model-changing linear knobs (linear_tree / linear_lambda /
+    # linear_max_features) deliberately STAY fingerprinted
+    "tpu_linear_warn_fallback",
 })
 
 

@@ -545,7 +545,7 @@ class ServingEngine:
                 if forest.num_trees == 0:
                     continue
                 codes, is_nan, is_zero = forest.encode_rows(X)
-                lv = forest.leaf_value64
+                lv = None if forest.has_linear else forest.leaf_value64
                 lo = 0
                 while lo < N:
                     if deadline is not None and obs.clock() > deadline:
@@ -558,10 +558,21 @@ class ServingEngine:
                         m, k, codes[lo:lo + n], is_nan[lo:lo + n],
                         is_zero[lo:lo + n], record=record)
                     # sequential f64 accumulation in tree order — the exact
-                    # operation order of Booster.predict's host loop
+                    # operation order of Booster.predict's host loop.
+                    # Linear-leaf forests route each tree's leaf indices
+                    # through Tree.leaf_outputs (the ONE home of host
+                    # linear evaluation): device traversal stays integer-
+                    # exact, the dot product runs host f64, and served
+                    # bits equal Booster.predict's
                     out = raw[k]
-                    for t in range(forest.num_trees):
-                        out[lo:lo + n] += lv[t, leaves[:, t]]
+                    if forest.has_linear:
+                        Xc = X[lo:lo + n]
+                        for t, tr in enumerate(forest._trees):
+                            out[lo:lo + n] += tr.leaf_outputs(
+                                Xc, leaves[:, t])
+                    else:
+                        for t in range(forest.num_trees):
+                            out[lo:lo + n] += lv[t, leaves[:, t]]
                     lo += n
         except DeviceDispatchError:
             if not allow_fallback:

@@ -39,7 +39,9 @@ class LGBMModel(_SKBase):
                  subsample_freq: int = 0, colsample_bytree: float = 1.0,
                  reg_alpha: float = 0.0, reg_lambda: float = 0.0,
                  random_state: Optional[int] = None, n_jobs: int = -1,
-                 silent: bool = True, importance_type: str = "split", **kwargs):
+                 silent: bool = True, importance_type: str = "split",
+                 linear_tree: bool = False, linear_lambda: float = 0.0,
+                 linear_max_features: int = 8, **kwargs):
         self.boosting_type = boosting_type
         self.num_leaves = num_leaves
         self.max_depth = max_depth
@@ -60,6 +62,11 @@ class LGBMModel(_SKBase):
         self.n_jobs = n_jobs
         self.silent = silent
         self.importance_type = importance_type
+        # piecewise-linear leaves (docs/Linear-Trees.md): first-class so
+        # get_params/set_params round-trip them for GridSearchCV & clone
+        self.linear_tree = linear_tree
+        self.linear_lambda = linear_lambda
+        self.linear_max_features = linear_max_features
         self._other_params = dict(kwargs)
         self._Booster: Optional[Booster] = None
         self._n_features = None
@@ -82,6 +89,9 @@ class LGBMModel(_SKBase):
             "reg_lambda": self.reg_lambda, "random_state": self.random_state,
             "n_jobs": self.n_jobs, "silent": self.silent,
             "importance_type": self.importance_type,
+            "linear_tree": self.linear_tree,
+            "linear_lambda": self.linear_lambda,
+            "linear_max_features": self.linear_max_features,
         }
         params.update(self._other_params)
         return params
@@ -110,6 +120,9 @@ class LGBMModel(_SKBase):
             "lambda_l1": self.reg_alpha,
             "lambda_l2": self.reg_lambda,
             "verbose": 0 if self.silent else 1,
+            "linear_tree": self.linear_tree,
+            "linear_lambda": self.linear_lambda,
+            "linear_max_features": self.linear_max_features,
         }
         if self._objective is not None:
             params["objective"] = self._objective

@@ -51,10 +51,24 @@ class Tree:
     # categorical splits: threshold_bin is an index into cat_boundaries
     cat_boundaries: Optional[np.ndarray] = None   # i32 [ncat+1]
     cat_threshold: Optional[np.ndarray] = None    # u32 bitset pool
+    # piecewise-linear leaves (linear_tree=true; later-LightGBM tree.h
+    # leaf_const_/leaf_coeff_/leaf_features_): per-leaf REAL feature index
+    # lists + coefficients; a leaf with an empty feature list is a constant
+    # leaf. A linear leaf's output is leaf_const + coeff . x, with
+    # leaf_value the missing-value fallback.
+    leaf_features: Optional[List[np.ndarray]] = None   # per leaf, i32 [k_l]
+    leaf_coeff: Optional[List[np.ndarray]] = None      # per leaf, f64 [k_l]
+    leaf_const: Optional[np.ndarray] = None            # f64 [L]
 
     @property
     def num_internal(self) -> int:
         return max(self.num_leaves - 1, 0)
+
+    @property
+    def is_linear(self) -> bool:
+        """True iff any leaf carries a fitted linear model."""
+        return self.leaf_features is not None and \
+            any(len(f) for f in self.leaf_features)
 
     # -- prediction on raw feature values ------------------------------------
 
@@ -115,12 +129,70 @@ class Tree:
         return out.astype(np.int32)
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        return self.leaf_value[self.predict_leaf(X)]
+        return self.leaf_outputs(X, self.predict_leaf(X))
+
+    def _linear_tables(self):
+        """Cached -1-padded per-leaf (features, coefficients, lengths)
+        tables for the vectorized ``leaf_outputs`` gather. Padding lanes
+        carry feature 0 / coefficient +0.0 and are value-masked to +0.0,
+        so the padded accumulation is an EXACT no-op per IEEE-754
+        (nonzero + ±0.0 and +0.0 + +0.0 are both exact) — bit-identical
+        to the ragged per-leaf loop."""
+        tabs = getattr(self, "_linear_tables_cache", None)
+        if tabs is None:
+            L = self.num_leaves
+            klen = np.array([len(f) for f in self.leaf_features[:L]],
+                            np.int64)
+            K = max(int(klen.max()), 1)
+            feat = np.zeros((L, K), np.int64)
+            coeff = np.zeros((L, K), np.float64)
+            for li in range(L):
+                k = klen[li]
+                if k:
+                    feat[li, :k] = self.leaf_features[li]
+                    coeff[li, :k] = self.leaf_coeff[li]
+            tabs = (feat, coeff, klen)
+            self._linear_tables_cache = tabs
+        return tabs
+
+    def leaf_outputs(self, X: np.ndarray, leaf_idx: np.ndarray) -> np.ndarray:
+        """f64 output per row GIVEN its leaf assignment.
+
+        Constant trees: the leaf_value gather. Linear trees: rows in a
+        linear leaf with every leaf feature present get ``leaf_const +
+        sum_k coeff_k * x_k`` (sequential in k — the EXACT operation order
+        the codegen oracle emits, so both stay bit-identical); rows with a
+        NaN leaf feature fall back to the constant ``leaf_value``
+        (later-LightGBM semantics). The one home of linear-leaf evaluation
+        on the host — ``ServingEngine`` calls it per (tree, chunk) so a
+        served linear model cannot drift from ``Booster.predict``. One
+        row-gather + K fused accumulation passes: O(rows * K), no per-leaf
+        scan over the chunk."""
+        out = self.leaf_value[leaf_idx].astype(np.float64)
+        if not self.is_linear:
+            return out
+        feat_t, coeff_t, klen = self._linear_tables()
+        feats = feat_t[leaf_idx]                               # [n, K]
+        coeff = coeff_t[leaf_idx]
+        used = np.arange(feat_t.shape[1])[None, :] < klen[leaf_idx][:, None]
+        xs = np.take_along_axis(np.asarray(X, np.float64), feats, axis=1)
+        xs = np.where(used, xs, 0.0)      # padding lanes: exact +0.0 terms
+        nanrow = np.isnan(xs).any(axis=1)
+        acc = self.leaf_const[leaf_idx].astype(np.float64)
+        for k in range(feat_t.shape[1]):
+            acc = acc + coeff[:, k] * xs[:, k]
+        lin = klen[leaf_idx] > 0
+        return np.where(lin & ~nanrow, acc, out)
 
     def shrink(self, rate: float) -> None:
-        """Tree::Shrinkage (tree.h:137-142)."""
+        """Tree::Shrinkage (tree.h:137-142); linear leaves scale intercept
+        and coefficients with the constant."""
         self.leaf_value = self.leaf_value * rate
         self.internal_value = self.internal_value * rate
+        if self.leaf_const is not None:
+            self.leaf_const = self.leaf_const * rate
+            self.leaf_coeff = [c * rate for c in self.leaf_coeff]
+            self._linear_tables_cache = None   # coefficients changed
         self.shrinkage *= rate
 
     # -- TreeSHAP feature contributions (reference tree.h:340-354
@@ -224,9 +296,17 @@ class Tree:
         return out
 
     def add_bias(self, bias: float) -> None:
-        """Tree::AddBias — fold boost-from-average into the first tree."""
+        """Tree::AddBias — fold boost-from-average into the first tree.
+        Linear leaves shift the intercept too (their output path bypasses
+        leaf_value except on missing-feature rows)."""
         self.leaf_value = self.leaf_value + bias
         self.internal_value = self.internal_value + bias
+        if self.leaf_const is not None:
+            lin = np.array([len(f) > 0 for f in self.leaf_features])
+            self.leaf_const = np.where(lin[: len(self.leaf_const)],
+                                       self.leaf_const + bias,
+                                       self.leaf_const)
+            self._linear_tables_cache = None   # intercepts changed
 
     def max_depth(self) -> int:
         if self.num_leaves <= 1:
@@ -287,6 +367,23 @@ def tree_from_device_arrays(arrs, mappers, real_feature_idx: np.ndarray) -> Tree
             cat_boundaries.append(cat_boundaries[-1] + n_words)
             cat_words.append(words)
 
+    # piecewise-linear leaves (ops/linear.py): device arrays hold INNER
+    # feature indices, -1-padded; the host model keeps per-leaf ragged
+    # lists in REAL feature space (what every interchange format writes)
+    leaf_features = leaf_coeff = leaf_const = None
+    dev_lf = getattr(arrs, "leaf_feat", None)
+    if dev_lf is not None:
+        dev_lf = np.asarray(dev_lf)
+        dev_lc = np.asarray(arrs.leaf_coeff, dtype=np.float64)
+        dev_const = np.asarray(arrs.leaf_const, dtype=np.float64)
+        leaf_features, leaf_coeff = [], []
+        for li in range(L):
+            sel = dev_lf[li] >= 0
+            leaf_features.append(
+                real_feature_idx[dev_lf[li][sel]].astype(np.int32))
+            leaf_coeff.append(dev_lc[li][sel])
+        leaf_const = dev_const[:L]
+
     has_cat = len(cat_words) > 0
     return Tree(
         num_leaves=nl,
@@ -304,4 +401,7 @@ def tree_from_device_arrays(arrs, mappers, real_feature_idx: np.ndarray) -> Tree
         leaf_parent=np.asarray(arrs.leaf_parent[:L], dtype=np.int32),
         cat_boundaries=np.asarray(cat_boundaries, dtype=np.int32) if has_cat else None,
         cat_threshold=np.concatenate(cat_words).astype(np.uint32) if has_cat else None,
+        leaf_features=leaf_features,
+        leaf_coeff=leaf_coeff,
+        leaf_const=leaf_const,
     )

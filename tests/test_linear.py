@@ -1,0 +1,352 @@
+"""Piecewise-linear leaves (linear_tree=true; ops/linear.py,
+docs/Linear-Trees.md): fit quality vs constant leaves on a
+piecewise-linear synthetic, interchange round trips pinned bit-identical
+(text/JSON/proto), ServingEngine parity with Booster.predict, the
+missing-value constant fallback, loud degradation on categorical paths,
+the zero-recompile steady state with the solve leg on, tree_batch
+bit-identity, checkpoint fingerprinting, sklearn passthrough, and the
+loud rejections (PMML, pred_contrib, unsupported boosting modes)."""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis.guards import RecompileGuard
+
+HAVE_GPP = os.system("which g++ > /dev/null 2>&1") == 0
+
+
+def _piecewise(n=3000, f=6, seed=0, missing_frac=0.0):
+    """Piecewise-linear target: the slope regime switches on feature 0 —
+    constant leaves must staircase what linear leaves fit exactly."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f) * 2.0
+    if missing_frac:
+        X[rng.rand(n, f) < missing_frac] = np.nan
+    y = np.where(np.nan_to_num(X[:, 0]) > 0,
+                 3.0 * np.nan_to_num(X[:, 1]) + 1.0,
+                 -2.0 * np.nan_to_num(X[:, 2]) + 0.5) \
+        + 0.05 * rng.randn(n)
+    return X, y
+
+
+PARAMS = dict(objective="regression", num_leaves=15, learning_rate=0.2,
+              min_data_in_leaf=20, verbose=-1, linear_tree=True,
+              linear_lambda=0.01, linear_max_features=4)
+
+
+def _train(params, X, y, rounds=8):
+    return lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                     num_boost_round=rounds)
+
+
+@pytest.fixture(scope="module")
+def reg_model():
+    X, y = _piecewise(missing_frac=0.02)
+    return _train(PARAMS, X, y), X, y
+
+
+@pytest.fixture(scope="module")
+def mc_model():
+    rng = np.random.RandomState(3)
+    X = rng.randn(2000, 5) * 2
+    y = np.digitize(X[:, 0] + 0.5 * X[:, 1], [-1, 1]).astype(np.float64)
+    p = dict(PARAMS, objective="multiclass", num_class=3, num_leaves=8)
+    return _train(p, X, y), X, y
+
+
+def _probe_rows(f, seed=7, n=256, nan_frac=0.15):
+    rng = np.random.RandomState(seed)
+    Xt = rng.randn(n, f) * 2
+    Xt[rng.rand(n, f) < nan_frac] = np.nan
+    return Xt
+
+
+# ------------------------------------------------------------- fit quality
+
+def test_linear_beats_constant_at_fixed_trees():
+    X, y = _piecewise()
+    lin = _train(PARAMS, X, y, rounds=10)
+    const = _train(dict(PARAMS, linear_tree=False), X, y, rounds=10)
+    mse_lin = float(np.mean((lin.predict(X) - y) ** 2))
+    mse_const = float(np.mean((const.predict(X) - y) ** 2))
+    assert mse_lin < mse_const, (mse_lin, mse_const)
+    assert any(t.is_linear for t in lin.trees)
+    assert not any(t.is_linear for t in const.trees)
+
+
+def test_leaf_model_shapes(reg_model):
+    b, _X, _y = reg_model
+    t = b.trees[0]
+    assert t.leaf_features is not None and len(t.leaf_features) == t.num_leaves
+    for li in range(t.num_leaves):
+        assert len(t.leaf_features[li]) == len(t.leaf_coeff[li])
+        assert len(t.leaf_features[li]) <= PARAMS["linear_max_features"]
+
+
+# ------------------------------------------------- interchange + serving
+
+def test_interchange_roundtrips_bit_identical(reg_model, tmp_path):
+    """text -> JSON -> proto chain, every hop bit-identical on rows with
+    missing values (the acceptance pin)."""
+    b, X, _y = reg_model
+    Xt = _probe_rows(X.shape[1])
+    want = b.predict(Xt)
+    txt = str(tmp_path / "m.txt")
+    b.save_model(txt)
+    b1 = lgb.Booster(model_file=txt)
+    assert np.array_equal(want, b1.predict(Xt))
+    jsn = str(tmp_path / "m.json")
+    b1.save_model(jsn)
+    b2 = lgb.Booster(model_file=jsn)
+    assert np.array_equal(want, b2.predict(Xt))
+    pb = str(tmp_path / "m.proto")
+    b2.save_model(pb)
+    b3 = lgb.Booster(model_file=pb)
+    assert np.array_equal(want, b3.predict(Xt))
+    # and back to text — the full cycle closes
+    txt2 = str(tmp_path / "m2.txt")
+    b3.save_model(txt2)
+    assert np.array_equal(want, lgb.Booster(model_file=txt2).predict(Xt))
+
+
+@pytest.mark.parametrize("fixture", ["reg_model",
+                                     pytest.param("mc_model",
+                                                  marks=pytest.mark.slow)])
+def test_serving_engine_bit_identical(request, fixture, tmp_path):
+    """ServingEngine.predict == Booster.predict on NaN-bearing rows, via
+    the proto artifact (regression fast; multiclass in the slow twin)."""
+    from lightgbm_tpu.serving import ServingEngine
+    b, X, _y = request.getfixturevalue(fixture)
+    pb = str(tmp_path / "m.proto")
+    b.save_model(pb)
+    Xt = _probe_rows(X.shape[1])
+    with ServingEngine(pb, params=dict(verbose=-1)) as eng:
+        assert eng._forests[0].has_linear
+        got = eng.predict(Xt)
+    want = b.predict(Xt)
+    assert np.array_equal(want, got, equal_nan=True)
+
+
+def test_serving_host_fallback_parity(reg_model, tmp_path):
+    """The degraded host path serves the SAME bits as the device path for
+    linear models (both route leaf evaluation through Tree.leaf_outputs)."""
+    from lightgbm_tpu.serving import ServingEngine
+    b, X, _y = reg_model
+    pb = str(tmp_path / "m.proto")
+    b.save_model(pb)
+    Xt = _probe_rows(X.shape[1])
+    with ServingEngine(pb, params=dict(verbose=-1)) as eng:
+        dev = eng.predict(Xt)
+        host = eng._finish_for(eng._model,
+                               eng._predict_host(eng._model, Xt), False)
+    assert np.array_equal(dev, host, equal_nan=True)
+
+
+def test_device_batch_predict_route(reg_model):
+    """forest_walk_linear (the device dot-product epilogue) agrees with the
+    host predictor: leaf traversal exact, outputs within f32 epsilon."""
+    b, X, _y = reg_model
+    Xt = np.tile(_probe_rows(X.shape[1]), (300, 1))   # force device route
+    host = b.predict(Xt, force_host_predict=True)
+    dev = b.predict(Xt)
+    scale = max(1.0, float(np.nanmax(np.abs(host))))
+    assert np.max(np.abs(host - dev)) < 1e-4 * scale
+
+
+# -------------------------------------------------------- fallback semantics
+
+def test_missing_value_rows_take_constant_output(reg_model):
+    """A row with NaN in one of its leaf's features outputs the constant
+    leaf_value — later-LightGBM semantics, pinned per leaf directly
+    through ``Tree.leaf_outputs`` (the one home of host linear
+    evaluation; routing is orthogonal and covered by the parity tests)."""
+    b, X, _y = reg_model
+    t = next(tr for tr in b.trees if tr.is_linear)
+    li = next(i for i in range(t.num_leaves) if len(t.leaf_features[i]))
+    feats = t.leaf_features[li]
+    lid = np.array([li], np.int32)
+    clean = np.ones((1, X.shape[1]), np.float64)
+    want = float(t.leaf_const[li])
+    for k in range(len(feats)):
+        want = want + float(t.leaf_coeff[li][k]) * 1.0
+    assert float(t.leaf_outputs(clean, lid)[0]) == want
+    # NaN in ANY leaf feature -> the constant fallback, exactly
+    for f in feats:
+        poisoned = clean.copy()
+        poisoned[0, f] = np.nan
+        assert float(t.leaf_outputs(poisoned, lid)[0]) \
+            == float(t.leaf_value[li])
+    # NaN in a feature the leaf does NOT use stays linear
+    unused = [f for f in range(X.shape[1]) if f not in set(feats)]
+    if unused:
+        poisoned = clean.copy()
+        poisoned[0, unused[0]] = np.nan
+        assert float(t.leaf_outputs(poisoned, lid)[0]) == want
+
+
+def test_categorical_path_degrades_to_constant():
+    """Leaves under a categorical split degrade LOUDLY to constant output
+    (empty feature list) — never silently-wrong coefficients."""
+    rng = np.random.RandomState(5)
+    n = 2000
+    X = np.column_stack([rng.randint(0, 4, n).astype(np.float64),
+                         rng.randn(n), rng.randn(n)])
+    y = np.where(X[:, 0] >= 2, 2.0 * X[:, 1], -1.0 * X[:, 2])
+    p = dict(PARAMS, num_leaves=8)
+    b = lgb.train(p, lgb.Dataset(X, label=y, params=p,
+                                 categorical_feature=[0]),
+                  num_boost_round=4)
+    saw_cat_split = False
+    for t in b.trees:
+        cat_nodes = [i for i in range(t.num_internal)
+                     if t.decision_type[i] & 1]
+        if not cat_nodes:
+            continue
+        saw_cat_split = True
+        # every leaf under a categorical node must be constant
+        def leaves_under(node):
+            out = []
+            stack = [node]
+            while stack:
+                nd = stack.pop()
+                for c in (t.left_child[nd], t.right_child[nd]):
+                    if c < 0:
+                        out.append(~c)
+                    else:
+                        stack.append(c)
+            return out
+        for nd in cat_nodes:
+            for li in leaves_under(int(nd)):
+                assert len(t.leaf_features[li]) == 0
+    assert saw_cat_split
+    # predictions stay finite and the model round-trips
+    assert np.isfinite(b.predict(X)).all()
+
+
+# --------------------------------------------------- recompiles + tree_batch
+
+def test_zero_recompile_steady_state():
+    """Steady-state waves + the fused solve leg: 0 jit cache misses after
+    warmup (the acceptance pin for the linear step program)."""
+    X, y = _piecewise(n=2000)
+    p = dict(PARAMS)
+    b = lgb.Booster(params=p, train_set=lgb.Dataset(X, label=y, params=p))
+    with RecompileGuard(label="linear", fail=True) as g:
+        for _ in range(3):
+            b.update()
+        np.asarray(b._gbdt.score).sum()
+        g.register(b._gbdt._step_fn, "train_step")
+        g.mark_warm()
+        for _ in range(4):
+            b.update()
+        np.asarray(b._gbdt.score).sum()
+
+
+def test_tree_batch_bit_identical():
+    """tree_batch=4 linear training == tree_batch=1 (the fit is traced
+    math inside the scanned step body, so fusion must not change bits)."""
+    X, y = _piecewise(n=2000)
+    m1 = _train(dict(PARAMS, tree_batch=1), X, y, rounds=4)
+    m4 = _train(dict(PARAMS, tree_batch=4), X, y, rounds=4)
+    assert m1.model_to_string() == m4.model_to_string()
+
+
+@pytest.mark.slow   # 3 full trainings; the fast fingerprint test below
+def test_checkpoint_resume_bit_identical(tmp_path):
+    X, y = _piecewise(n=2000)
+    p = dict(PARAMS, checkpoint_dir=str(tmp_path / "ck"),
+             checkpoint_interval=2, metric="l2")
+    full = _train(p, X, y, rounds=6).model_to_string()
+    _train(p, X, y, rounds=4)                      # leaves snapshots behind
+    resumed = lgb.train(dict(p, resume_from="auto"),
+                        lgb.Dataset(X, label=y, params=p),
+                        num_boost_round=6)
+    assert resumed.model_to_string() == full
+
+
+def test_checkpoint_fingerprint_includes_linear_tree():
+    """linear_tree changes the model — a snapshot must not resume across
+    the flag (solver loudness knobs stay volatile)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.robustness.checkpoint import (VOLATILE_CONFIG_FIELDS,
+                                                    config_fingerprint)
+    base = Config.from_params(dict(verbose=-1, linear_tree=True))
+    for knob, val in (("linear_tree", False), ("linear_lambda", 0.5),
+                      ("linear_max_features", 3)):
+        assert knob not in VOLATILE_CONFIG_FIELDS
+        other = Config.from_params(
+            dict({"verbose": -1, "linear_tree": True}, **{knob: val}))
+        assert config_fingerprint(base) != config_fingerprint(other), knob
+    # the loudness knob is deliberately volatile (never the math)
+    assert "tpu_linear_warn_fallback" in VOLATILE_CONFIG_FIELDS
+    assert config_fingerprint(base) == config_fingerprint(
+        Config.from_params(dict(verbose=-1, linear_tree=True,
+                                tpu_linear_warn_fallback=False)))
+
+
+# ------------------------------------------------------------- sklearn + cfg
+
+def test_sklearn_passthrough_roundtrip():
+    from lightgbm_tpu.sklearn import LGBMRegressor
+    m = LGBMRegressor(n_estimators=4, num_leaves=8, linear_tree=True,
+                      linear_lambda=0.1, linear_max_features=3, verbose=-1)
+    p = m.get_params()
+    assert p["linear_tree"] is True and p["linear_lambda"] == 0.1 \
+        and p["linear_max_features"] == 3
+    m.set_params(linear_lambda=0.25)
+    assert m.get_params()["linear_lambda"] == 0.25
+    X, y = _piecewise(n=1500)
+    m.fit(X, y)
+    assert any(t.is_linear for t in m.booster_.trees)
+    m2 = LGBMRegressor(**m.get_params())
+    assert m2.get_params()["linear_lambda"] == 0.25
+
+
+@pytest.mark.parametrize("bad", [
+    dict(boosting_type="dart"), dict(boosting_type="rf", bagging_freq=1,
+                                     bagging_fraction=0.5),
+    dict(tpu_residency="stream"), dict(linear_lambda=-1.0),
+    dict(linear_max_features=0)])
+def test_config_rejections(bad):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        Config.from_params(dict(verbose=-1, linear_tree=True, **bad))
+
+
+def test_loud_export_rejections(reg_model):
+    b, X, _y = reg_model
+    from lightgbm_tpu.io.pmml import model_to_pmml
+    with pytest.raises(ValueError, match="linear"):
+        model_to_pmml(b)
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        b.predict(X[:4], pred_contrib=True)
+
+
+# ----------------------------------------------------------- codegen oracle
+
+@pytest.mark.skipif(not HAVE_GPP, reason="g++ unavailable")
+def test_codegen_oracle_bit_identical(reg_model, tmp_path):
+    """The compiled if-else oracle reproduces Booster.predict bit-for-bit
+    for linear leaves (same left-to-right accumulation order)."""
+    from lightgbm_tpu.io.codegen import model_to_cpp
+    b, X, _y = reg_model
+    cpp = tmp_path / "model.cpp"
+    cpp.write_text(model_to_cpp(b))
+    so = tmp_path / "model.so"
+    subprocess.check_call(["g++", "-O2", "-shared", "-fPIC", str(cpp),
+                           "-o", str(so)])
+    lib = ctypes.CDLL(str(so))
+    lib.PredictRawSingle.restype = ctypes.c_double
+    lib.PredictRawSingle.argtypes = [ctypes.POINTER(ctypes.c_double)]
+    Xt = np.ascontiguousarray(_probe_rows(X.shape[1], n=64))
+    got = np.array([lib.PredictRawSingle(
+        Xt[i].ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        for i in range(len(Xt))])
+    want = b.predict(Xt, raw_score=True)
+    assert np.array_equal(want, got, equal_nan=True)

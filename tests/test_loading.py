@@ -162,3 +162,36 @@ def test_libsvm_two_round_matches_one_round(tmp_path):
     np.testing.assert_array_equal(a.real_feature_idx, b.real_feature_idx)
     np.testing.assert_array_equal(a.X_binned, b.X_binned)
     np.testing.assert_array_equal(a.metadata.label, b.metadata.label)
+
+
+def test_binary_dataset_preserves_raw_slice_for_linear(tmp_path):
+    """A binary dataset saved under linear_tree=true keeps the raw f32
+    feature slice (dataset.py X_raw) so a reloaded dataset can still fit
+    per-leaf linear models; one saved WITHOUT linear_tree rejects loudly
+    instead of silently training constant-only coefficients."""
+    from lightgbm_tpu.utils.log import LightGBMError
+    rng = np.random.RandomState(2)
+    X = rng.randn(500, 4) * 2
+    y = np.where(X[:, 0] > 0, 2.0 * X[:, 1], -X[:, 2])
+    p_lin = dict(objective="regression", num_leaves=8, min_data_in_leaf=10,
+                 verbose=-1, linear_tree=True)
+    ds = lgb.Dataset(X, label=y, params=p_lin)
+    ds.construct()
+    bpath = str(tmp_path / "lin.bin")
+    ds.save_binary(bpath)
+    ds2 = lgb.Dataset(bpath, params=p_lin)
+    ds2.construct()
+    assert ds2._constructed.X_raw is not None
+    np.testing.assert_array_equal(ds2._constructed.X_raw,
+                                  ds._constructed.X_raw)
+    b = lgb.train(p_lin, ds2, num_boost_round=2)
+    assert any(t.is_linear for t in b.trees)
+    # a binary dataset written WITHOUT the raw slice fails loudly
+    p_const = dict(p_lin, linear_tree=False)
+    ds3 = lgb.Dataset(X, label=y, params=p_const)
+    ds3.construct()
+    bpath2 = str(tmp_path / "const.bin")
+    ds3.save_binary(bpath2)
+    ds4 = lgb.Dataset(bpath2, params=p_lin)
+    with pytest.raises(LightGBMError, match="raw feature slice"):
+        lgb.train(p_lin, ds4, num_boost_round=1)

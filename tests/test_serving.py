@@ -490,3 +490,34 @@ def test_ledger_serve_key_and_p99_gate():
     good = dict(serve, value=51000.0, p99_ms=41.0)
     problems, _ = ledger.compare(good, hist)
     assert problems == []
+
+
+# --------------------------------------------------- piecewise-linear leaves
+
+def test_linear_model_serves_and_reloads_bit_identical(tmp_path):
+    """A linear_tree model (docs/Linear-Trees.md) through the full engine
+    lifecycle: proto load, NaN-bearing traffic, and a hot reload to a
+    SECOND linear model — every response bit-identical to the matching
+    booster's predict (the reload verification gate runs the linear host
+    epilogue end-to-end)."""
+    rng = np.random.RandomState(21)
+    X = rng.randn(2000, 6) * 2
+    y = np.where(X[:, 0] > 0, 3.0 * X[:, 1], -2.0 * X[:, 2])
+    p = dict(objective="regression", num_leaves=15, min_data_in_leaf=20,
+             verbose=-1, linear_tree=True, linear_lambda=0.01,
+             linear_max_features=3)
+    b1 = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=5)
+    b2 = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=8)
+    pb1, pb2 = str(tmp_path / "m1.proto"), str(tmp_path / "m2.proto")
+    b1.save_model(pb1)
+    b2.save_model(pb2)
+    Xt = rng.randn(128, 6) * 2
+    Xt[rng.rand(128, 6) < 0.15] = np.nan
+    with ServingEngine(pb1, params=dict(verbose=-1)) as eng:
+        assert eng._forests[0].has_linear
+        assert np.array_equal(b1.predict(Xt), eng.predict(Xt),
+                              equal_nan=True)
+        v = eng.reload(pb2, params=dict(verbose=-1))
+        assert v == 2
+        assert np.array_equal(b2.predict(Xt), eng.predict(Xt),
+                              equal_nan=True)
