@@ -10,10 +10,18 @@
 # compile cache in .jax_cache; `check-fast` is ~4 min cold.
 PYTEST := python -m pytest -q
 
-# Static JAX/TPU hygiene pass (rules R001-R012, see docs/Static-Analysis.md).
-# Exits non-zero on any finding not covered by tpu_lint_baseline.json.
+# Static JAX/TPU hygiene, both tiers (docs/Static-Analysis.md):
+#   1. AST tier  — rules R001-R012 over the package source with the
+#      whole-package call graph; findings gate unless covered by
+#      tpu_lint_baseline.json.
+#   2. trace tier — contracts T001+ over the SHIPPED entry points' jaxprs
+#      and optimized HLO (sort-free wave body, gather-free bundle routing,
+#      collective set vs the cost model, f64 discipline, donation
+#      aliasing, no host transfers in loop bodies); gates unless covered
+#      by trace_lint_baseline.json.
 lint:
 	python -m lightgbm_tpu.analysis lightgbm_tpu/
+	python -m lightgbm_tpu.analysis --trace
 
 # CI gate: lint + tier-1 tests + the recompile guard on a 5-iter smoke run
 # (which also asserts checkpoint save/resume stays recompile-free, that the

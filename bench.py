@@ -1495,6 +1495,50 @@ def run_smoke():
     except Exception as e:            # noqa: BLE001 — any failure fails CI
         lin_ok, lin_err = False, f"{type(e).__name__}: {e}"
 
+    # ---- trace-lint interference (analysis/trace_lint.py) ------------------
+    # `make lint`'s trace tier traces and lowers the SHIPPED entry points
+    # (contracts T001+, docs/Static-Analysis.md "Trace contracts"). Running
+    # the whole registry in-process next to a live booster must add ZERO
+    # post-warm-up recompiles to a subsequent guarded loop: make_jaxpr
+    # never executes, and the contract programs trace on their own (tiny)
+    # shapes, so the warm step executable stays warm. Cells whose builder
+    # needs a multi-device topology (data8) are skipped on this
+    # single-device smoke — `make lint` covers them under 8 virtual devices.
+    trace_ok, trace_err = True, None
+    trace_misses, trace_cells, trace_skipped = -1, 0, 0
+    try:
+        from lightgbm_tpu.analysis import contracts as treg
+        import lightgbm_tpu.analysis.contracts.entries  # noqa: F401
+        for cid in sorted(treg.CONTRACTS):
+            c = treg.CONTRACTS[cid]
+            for t in c.targets:
+                try:
+                    program = treg.build_program(c.entry, t.shape_class)
+                except RuntimeError:      # topology-gated cell (needs >=2 dev)
+                    trace_skipped += 1
+                    continue
+                bad = treg.evaluate(c, t, program)
+                if bad:
+                    raise RuntimeError(
+                        f"trace contract {cid}@{t.shape_class}: {bad[0][1]}")
+                trace_cells += 1
+        guard_t = RecompileGuard(label="smoke-post-trace")
+        guard_t.register(bst._gbdt._step_fn, "train_step")
+        with guard_t:
+            guard_t.mark_warm()
+            for _ in range(iters):
+                bst.update()
+            np.asarray(bst._gbdt.score).sum()
+        trace_misses = guard_t.report()["post_warmup_cache_misses"]
+        if trace_misses:
+            raise RuntimeError(
+                f"trace tier perturbed the warm step: {trace_misses} "
+                f"post-warm-up cache miss(es) in the follow-up loop")
+    except GuardViolation as e:
+        trace_ok, trace_err = False, str(e)
+    except Exception as e:            # noqa: BLE001 — any failure fails CI
+        trace_ok, trace_err = False, f"{type(e).__name__}: {e}"
+
     # ---- golden cost pin for the fused step (observability/costs.py) -------
     # The fused train step's compile-time FLOPs/bytes-accessed must sit
     # inside the tolerance band of the committed goldens
@@ -1546,8 +1590,12 @@ def run_smoke():
            "linear_ok": lin_ok,
            "linear_post_warmup_cache_misses": lin_misses,
            "linear_host_syncs": lin_syncs,
+           "trace_lint_ok": trace_ok,
+           "trace_lint_cells": trace_cells,
+           "trace_lint_cells_skipped": trace_skipped,
+           "trace_lint_post_warmup_cache_misses": trace_misses,
            "ok": (ok and resume_ok and cache_ok and tel_ok and cost_ok
-                  and rob_ok and efb_ok and lin_ok)}
+                  and rob_ok and efb_ok and lin_ok and trace_ok)}
     if err:
         out["error"] = err[:300]
     if resume_err:
@@ -1564,6 +1612,8 @@ def run_smoke():
         out["efb_error"] = efb_err[:300]
     if lin_err:
         out["linear_error"] = lin_err[:300]
+    if trace_err:
+        out["trace_lint_error"] = trace_err[:300]
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

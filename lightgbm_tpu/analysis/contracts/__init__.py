@@ -1,0 +1,25 @@
+"""Trace contracts: declarative jaxpr/HLO predicates over the shipped
+entry points (docs/Static-Analysis.md, "Trace contracts").
+
+This package front door stays importable WITHOUT jax: product modules
+(`grower.py`, `ops/linear.py`, `ops/predict.py`, `boosting/gbdt.py`)
+import :func:`trace_entry` from here at import time, and the AST lint
+tier imports :mod:`jaxpr_utils` regexes. Only `entries.py` — the program
+builders — pulls in jax, and only when the trace tier actually runs
+(``python -m lightgbm_tpu.analysis --trace`` / tests).
+"""
+from .registry import (  # noqa: F401
+    CONTRACTS,
+    Contract,
+    ENTRY_POINTS,
+    PROGRAM_BUILDERS,
+    Target,
+    TracedProgram,
+    build_program,
+    contract,
+    evaluate,
+    evaluate_target,
+    get_entry,
+    program_builder,
+    trace_entry,
+)

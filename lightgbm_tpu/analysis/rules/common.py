@@ -6,11 +6,21 @@ parameter of a function the tracer provably enters — jit-decorated,
 jit-wrapped, or passed to a jax.lax control-flow primitive). The goal is a
 near-zero false-positive rate on idiomatic host-side code; the baseline
 file absorbs the audited remainder.
+
+The second half of this module is the **whole-package call graph**
+(:class:`PackageIndex`): module-level import resolution (absolute,
+``as``-aliased, and relative forms) plus attribute-call binding
+(``mod.fn(...)`` through an imported module object, ``self.m(...)`` to a
+method of the enclosing class), built once per lint run over every file in
+the invocation and cached. The reachability rules (R007/R009/R012) walk it
+instead of the old same-file-only map, so a sort hidden behind
+``from .ops import histogram`` is no longer invisible.
 """
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional, Set, Tuple
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 # dotted prefixes whose call results are jax arrays (tracer-carrying)
 TRACED_CALL_PREFIXES = (
@@ -196,6 +206,320 @@ def _assign_targets(stmt: ast.AST) -> List[str]:
     elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
         collect(stmt.target)
     return names
+
+
+def referenced_callables(fn: ast.FunctionDef) -> Set[str]:
+    """Names (bare and dotted) this function's body may call or forward.
+
+    Collects every Name load and every Name-rooted Attribute chain — both
+    ``helper(x)`` and ``histmod.compact(x)`` forms, plus bare references
+    passed onward as callables (``lax.while_loop(cond, body, ...)``).
+    """
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute):
+            name = dotted_name(node)
+            if name:
+                out.add(name)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            out.add(node.id)
+    return out
+
+
+class ModuleInfo:
+    """Per-file slice of the package call graph: top-of-tree defs, class
+    methods, and the two import maps (module aliases, from-imports)."""
+
+    def __init__(self, path: str, rel: str, tree: ast.Module):
+        self.path = path
+        self.rel = rel
+        self.tree = tree
+        self.modname = _modname_for_rel(rel)
+        self.defs: Dict[str, ast.FunctionDef] = {}
+        self.classes: Dict[str, Dict[str, ast.FunctionDef]] = {}
+        # alias -> dotted module it denotes (``import a.b as c`` => c: a.b;
+        # ``import a.b`` => a: a, attribute chains re-join the tail)
+        self.import_modules: Dict[str, str] = {}
+        # alias -> (resolved source module, attribute name)
+        self.import_names: Dict[str, Tuple[str, str]] = {}
+        # id(fn) -> enclosing ClassDef name (innermost), for self.m() binding
+        self.owner_class: Dict[int, str] = {}
+        self._index_defs()
+        self._index_imports()
+
+    def _index_defs(self) -> None:
+        def visit(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if cls is None:
+                        self.defs.setdefault(child.name, child)
+                    else:
+                        self.classes.setdefault(cls, {}).setdefault(
+                            child.name, child)
+                    if cls is not None:
+                        self.owner_class[id(child)] = cls
+                    visit(child, cls)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                else:
+                    visit(child, cls)
+
+        visit(self.tree, None)
+        # nested defs are callable too (closures handed to lax primitives);
+        # record owners but only expose module-level names in ``defs`` —
+        # resolution of nested names happens through reachability, not
+        # imports, so the name map stays unambiguous.
+
+    def _index_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.import_modules[alias.asname] = alias.name
+                    else:
+                        head = alias.name.split(".")[0]
+                        self.import_modules[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                src = self._resolve_from(node)
+                if src is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    self.import_names[bound] = (src, alias.name)
+
+    def _resolve_from(self, node: ast.ImportFrom) -> Optional[str]:
+        if node.level == 0:
+            return node.module
+        parts = self.modname.split(".")
+        # ``from . import x`` in a module drops the module's own leaf; each
+        # extra dot climbs one more package
+        if len(parts) < node.level:
+            return node.module  # fixture linted standalone: best effort
+        base = parts[:-node.level] if node.level <= len(parts) else []
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base) if base else node.module
+
+
+def _modname_for_rel(rel: str) -> str:
+    norm = rel.replace(os.sep, "/")
+    if norm.endswith(".py"):
+        norm = norm[:-3]
+    if norm.endswith("/__init__"):
+        norm = norm[: -len("/__init__")]
+    return norm.replace("/", ".")
+
+
+class PackageIndex:
+    """Whole-package call graph over every file in one lint invocation.
+
+    Modules are matched by dotted-suffix (the linted tree's relative paths
+    rarely coincide with installed import paths), imports are resolved
+    through both alias maps, and reachability from the jax.lax loop
+    primitives is a cross-module BFS cached per root set.
+    """
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_path: Dict[str, ModuleInfo] = {}
+        self._by_tail: Dict[str, List[ModuleInfo]] = {}
+        for m in modules:
+            self.modules[m.modname] = m
+            self.by_path[m.rel] = m
+            tail = m.modname.rsplit(".", 1)[-1]
+            self._by_tail.setdefault(tail, []).append(m)
+        self._reach_cache: Dict[frozenset, Dict[int, Tuple[ModuleInfo,
+                                                           ast.FunctionDef]]] \
+            = {}
+        self._module_cache: Dict[Tuple[str, str], Optional[ModuleInfo]] = {}
+        self._defs_cache: Dict[int, Dict[str, ast.FunctionDef]] = {}
+
+    def _local_defs(self, mod: ModuleInfo) -> Dict[str, ast.FunctionDef]:
+        cached = self._defs_cache.get(id(mod))
+        if cached is None:
+            cached = _all_defs(mod.tree)
+            self._defs_cache[id(mod)] = cached
+        return cached
+
+    @classmethod
+    def build(cls, files: Iterable[Tuple[str, str, ast.Module]]
+              ) -> "PackageIndex":
+        return cls([ModuleInfo(p, r, t) for p, r, t in files])
+
+    # ------------------------------------------------------------- modules
+
+    def find_module(self, dotted: Optional[str],
+                    near: Optional[ModuleInfo] = None) -> Optional[ModuleInfo]:
+        if not dotted:
+            return None
+        key = (dotted, near.modname if near else "")
+        if key in self._module_cache:
+            return self._module_cache[key]
+        out = self._find_module(dotted, near)
+        self._module_cache[key] = out
+        return out
+
+    def _find_module(self, dotted: str,
+                     near: Optional[ModuleInfo]) -> Optional[ModuleInfo]:
+        if dotted in self.modules:
+            return self.modules[dotted]
+        parts = dotted.split(".")
+        cands = [m for m in self._by_tail.get(parts[-1], ())
+                 if m.modname == dotted
+                 or m.modname.endswith("." + dotted)
+                 or dotted.endswith("." + m.modname)]
+        if not cands:
+            return None
+        if len(cands) == 1 or near is None:
+            return cands[0]
+        # disambiguate by shared package prefix with the importing module
+        def score(m: ModuleInfo) -> int:
+            a, b = m.modname.split("."), near.modname.split(".")
+            n = 0
+            while n < min(len(a), len(b)) and a[n] == b[n]:
+                n += 1
+            return n
+        return max(cands, key=score)
+
+    # ----------------------------------------------------------- resolution
+
+    def resolve(self, mod: ModuleInfo, dotted: str
+                ) -> List[Tuple[ModuleInfo, ast.FunctionDef]]:
+        """Best-effort binding of a (possibly dotted) callable reference in
+        ``mod`` to function defs anywhere in the package."""
+        parts = dotted.split(".")
+        head, rest = parts[0], parts[1:]
+        if not rest:
+            if head in mod.defs:
+                return [(mod, mod.defs[head])]
+            if head in mod.import_names:
+                src, attr = mod.import_names[head]
+                tm = self.find_module(src, near=mod)
+                if tm and attr in tm.defs:
+                    return [(tm, tm.defs[attr])]
+            return []
+        # mod-object attribute call: histmod.compact(...), pkg.sub.fn(...)
+        out: List[Tuple[ModuleInfo, ast.FunctionDef]] = []
+        if head in mod.import_modules:
+            base = mod.import_modules[head]
+            tm = self.find_module(".".join([base] + rest[:-1]), near=mod)
+            if tm and rest[-1] in tm.defs:
+                out.append((tm, tm.defs[rest[-1]]))
+        if head in mod.import_names:
+            # ``from pkg import sub`` then sub.fn(...): the bound name is a
+            # module, not a def
+            src, attr = mod.import_names[head]
+            tm = self.find_module(
+                ".".join([src, attr] + rest[:-1]), near=mod)
+            if tm and rest[-1] in tm.defs:
+                out.append((tm, tm.defs[rest[-1]]))
+        return out
+
+    def resolve_method(self, mod: ModuleInfo, fn: ast.FunctionDef,
+                       method: str
+                       ) -> List[Tuple[ModuleInfo, ast.FunctionDef]]:
+        """``self.method(...)`` inside ``fn`` -> same-class method."""
+        cls = mod.owner_class.get(id(fn))
+        if cls is None:
+            return []
+        tgt = mod.classes.get(cls, {}).get(method)
+        return [(mod, tgt)] if tgt is not None else []
+
+    # --------------------------------------------------------- reachability
+
+    def loop_roots(self, loop_calls: Iterable[str]
+                   ) -> List[Tuple[ModuleInfo, ast.FunctionDef]]:
+        """Every function handed (by name) to one of ``loop_calls`` anywhere
+        in the package — lambdas count via their enclosing function, which
+        the BFS already visits."""
+        loop_set = set(loop_calls)
+        roots: List[Tuple[ModuleInfo, ast.FunctionDef]] = []
+        for mod in self.modules.values():
+            local_defs = self._local_defs(mod)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted_name(node.func) not in loop_set:
+                    continue
+                cands = list(node.args) + [kw.value for kw in node.keywords]
+                for arg in cands:
+                    if isinstance(arg, ast.Lambda):
+                        roots.append((mod, arg))
+                        continue
+                    name = dotted_name(arg)
+                    if not name:
+                        continue
+                    if name in local_defs:
+                        roots.append((mod, local_defs[name]))
+                    else:
+                        roots.extend(self.resolve(mod, name))
+        return roots
+
+    def reachable_from_loops(self, loop_calls: frozenset
+                             ) -> Dict[int, Tuple[ModuleInfo,
+                                                  ast.FunctionDef]]:
+        """Transitive closure of functions reachable from jax.lax loop
+        bodies, across module boundaries. Keyed by id(fn)."""
+        cached = self._reach_cache.get(loop_calls)
+        if cached is not None:
+            return cached
+        seen: Dict[int, Tuple[ModuleInfo, ast.FunctionDef]] = {}
+        frontier = list(self.loop_roots(loop_calls))
+        while frontier:
+            mod, fn = frontier.pop()
+            if id(fn) in seen:
+                continue
+            seen[id(fn)] = (mod, fn)
+            local_defs = self._local_defs(mod)
+            for name in referenced_callables(fn):
+                if "." not in name and name in local_defs:
+                    tgt = local_defs[name]
+                    if id(tgt) not in seen:
+                        frontier.append((mod, tgt))
+                    continue
+                if name.startswith("self."):
+                    for pair in self.resolve_method(
+                            mod, fn, name.split(".", 1)[1].split(".")[0]):
+                        if id(pair[1]) not in seen:
+                            frontier.append(pair)
+                    continue
+                for pair in self.resolve(mod, name):
+                    if id(pair[1]) not in seen:
+                        frontier.append(pair)
+        self._reach_cache[loop_calls] = seen
+        return seen
+
+
+def _all_defs(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    """Every def in the file by name, outermost-first on collision — the
+    historical same-file map, kept for nested-closure resolution."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def single_file_index(path: str, rel: str, tree: ast.Module) -> PackageIndex:
+    """Degenerate one-module index: standalone ``lint_file`` calls keep the
+    historical same-file reachability semantics."""
+    return PackageIndex.build([(path, rel, tree)])
+
+
+def reachable_loop_code(ctx, loop_calls: frozenset) -> List[ast.AST]:
+    """Functions and lambdas reachable from jax.lax loop bodies that live in
+    ``ctx``'s file — package-wide when the lint run attached a
+    :class:`PackageIndex` (``ctx.package``), same-file otherwise."""
+    index = getattr(ctx, "package", None)
+    if index is None:
+        index = single_file_index(ctx.path, ctx.rel, ctx.tree)
+    mod = index.by_path.get(ctx.rel)
+    if mod is None:
+        return []
+    reach = index.reachable_from_loops(loop_calls)
+    return [fn for (m, fn) in reach.values() if m is mod]
 
 
 def infer_traced_names(fn: ast.FunctionDef, params_traced: bool,

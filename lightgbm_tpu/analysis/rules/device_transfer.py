@@ -11,25 +11,26 @@ home with stall accounting, overlap, and byte counters:
 cache. Those two files are exempt; a ``device_put`` reachable from a loop
 body anywhere else is a finding.
 
-Detection reuses R007's intra-module reachability walk: callables handed
-to ``lax.while_loop`` OR ``lax.scan`` (by name or inline lambda) are
-roots; any same-file function they reference is reachable; a
+Detection reuses R007's whole-package reachability walk
+(``common.PackageIndex``): callables handed to ``lax.while_loop`` OR
+``lax.scan`` anywhere in the lint run are roots; any function they
+reference — same-file or across an import — is reachable; a
 ``jax.device_put``/``jax.device_get`` (or ``device_put``/``device_get``
-imported from jax) call in reachable code fires. Cross-module calls are
-invisible to the AST pass (documented limitation shared with R007);
-intentional sites belong in ``tpu_lint_baseline.json``.
+imported from jax) call in reachable code fires. ``from jax import``
+aliases are resolved per the module the reachable code lives in, so an
+aliased transfer two imports away from the loop still fires. Intentional
+sites belong in ``tpu_lint_baseline.json``.
 """
 from __future__ import annotations
 
 import ast
 
-from .common import dotted_name
-from .sort_in_loop import _local_defs, _referenced_names
+from .common import dotted_name, reachable_loop_code
 
 RULE_ID = "R009"
 
-_LOOP_CALLS = {"jax.lax.while_loop", "lax.while_loop",
-               "jax.lax.scan", "lax.scan"}
+_LOOP_CALLS = frozenset({"jax.lax.while_loop", "lax.while_loop",
+                         "jax.lax.scan", "lax.scan"})
 _TRANSFER_DOTTED = {"jax.device_put", "jax.device_get"}
 _TRANSFER_FROM = {"device_put", "device_get"}
 
@@ -55,6 +56,7 @@ def _from_jax_aliases(tree) -> set:
 
 class DeviceTransferRule:
     rule_id = RULE_ID
+    cross_module = True   # findings depend on the whole-package call graph
     summary = ("jax.device_put/device_get reachable from a lax.while_loop "
                "or lax.scan body outside ops/stream.py / dataset.py — "
                "mid-loop transfers belong to the streaming prefetcher")
@@ -62,42 +64,10 @@ class DeviceTransferRule:
     def check(self, ctx):
         if _exempt(ctx.rel):
             return
-        defs = _local_defs(ctx.tree)
         aliases = _from_jax_aliases(ctx.tree)
 
-        # roots: callables handed to while_loop/scan (positional or kw)
-        roots = []
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            if dotted_name(node.func) not in _LOOP_CALLS:
-                continue
-            for arg in list(node.args) + [kw.value for kw in node.keywords]:
-                if isinstance(arg, ast.Lambda):
-                    roots.append(arg)
-                else:
-                    name = dotted_name(arg)
-                    if name in defs:
-                        roots.append(defs[name])
-        if not roots:
-            return
-
-        # reachability over same-file defs via loaded names (R007's walk)
-        reachable, frontier = [], list(roots)
-        seen = set()
-        while frontier:
-            fn = frontier.pop()
-            if id(fn) in seen:
-                continue
-            seen.add(id(fn))
-            reachable.append(fn)
-            for name in _referenced_names(fn):
-                target = defs.get(name)
-                if target is not None and id(target) not in seen:
-                    frontier.append(target)
-
         reported = set()
-        for fn in reachable:
+        for fn in reachable_loop_code(ctx, _LOOP_CALLS):
             for node in ast.walk(fn):
                 if not isinstance(node, ast.Call):
                     continue

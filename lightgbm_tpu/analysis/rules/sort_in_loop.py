@@ -9,25 +9,28 @@ slot grouping derives from carried per-leaf segment tables, compaction from
 prefix sums + monotonic scatters (ops/histogram.py compact_rows /
 slot_position_base).
 
-Detection is an intra-module reachability walk: functions passed to
-``lax.while_loop`` (by name or inline lambda) are roots; any same-file
-function they reference — called directly, or passed onward to e.g.
-``lax.cond`` — is reachable; a ``jnp.argsort``/``jnp.sort``/``jnp.lexsort``/
-``lax.sort``/``lax.sort_key_val`` call in reachable code fires. Cross-module
-calls are invisible to the AST pass (documented limitation); the audited
-intentional site — the grower's LEGACY compact path, kept as the
-bit-identity pin for ``tpu_incremental_partition=false`` — lives in the
-committed baseline.
+Detection is a reachability walk over the whole-package call graph
+(``common.PackageIndex``): functions passed to ``lax.while_loop`` (by name
+or inline lambda) anywhere in the lint run are roots; any function they
+reference — called directly, through an imported module object, via a
+``self.`` method, or passed onward to e.g. ``lax.cond`` — is reachable,
+across module boundaries; a ``jnp.argsort``/``jnp.sort``/``jnp.lexsort``/
+``lax.sort``/``lax.sort_key_val`` call in reachable code fires. Linting a
+single file degrades to the historical same-file walk. Audited intentional
+sites — the grower's LEGACY compact path (the bit-identity pin for
+``tpu_incremental_partition=false``) — live in the committed baseline;
+deliberate small-axis sorts (categorical bin ordering, voting gain ranks)
+carry inline waivers at the call site.
 """
 from __future__ import annotations
 
 import ast
 
-from .common import dotted_name
+from .common import dotted_name, reachable_loop_code
 
 RULE_ID = "R007"
 
-_WHILE_LOOP = {"jax.lax.while_loop", "lax.while_loop"}
+_WHILE_LOOP = frozenset({"jax.lax.while_loop", "lax.while_loop"})
 _SORT_CALLS = {
     "jnp.argsort", "jnp.sort", "jnp.lexsort",
     "jax.numpy.argsort", "jax.numpy.sort", "jax.numpy.lexsort",
@@ -36,71 +39,16 @@ _SORT_CALLS = {
 }
 
 
-def _local_defs(tree):
-    """Every function def in the module (nested included), by name.
-
-    Name collisions keep the FIRST def — conservative for a lint heuristic;
-    the reachability walk only follows names, never instances."""
-    defs = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            defs.setdefault(node.name, node)
-    return defs
-
-
-def _referenced_names(fn):
-    """Names a function loads anywhere in its body — covers direct calls
-    AND functions passed as arguments (``lax.cond(pred, compact_pass, ...)``
-    reaches ``compact_pass`` without a Call node naming it)."""
-    out = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-            out.add(node.id)
-    return out
-
-
 class SortInLoopRule:
     rule_id = RULE_ID
+    cross_module = True   # findings depend on the whole-package call graph
     summary = ("argsort/sort reachable from a lax.while_loop body — a "
                "per-iteration fixed cost; use the carried incremental "
                "partition / prefix-sum compaction instead")
 
     def check(self, ctx):
-        defs = _local_defs(ctx.tree)
-
-        # roots: callables handed to while_loop (positional or cond=/body=)
-        roots = []          # FunctionDef or Lambda nodes
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            if dotted_name(node.func) not in _WHILE_LOOP:
-                continue
-            for arg in list(node.args) + [kw.value for kw in node.keywords]:
-                if isinstance(arg, ast.Lambda):
-                    roots.append(arg)
-                else:
-                    name = dotted_name(arg)
-                    if name in defs:
-                        roots.append(defs[name])
-        if not roots:
-            return
-
-        # reachability over same-file defs via loaded names
-        reachable, frontier = [], list(roots)
-        seen = set()
-        while frontier:
-            fn = frontier.pop()
-            if id(fn) in seen:
-                continue
-            seen.add(id(fn))
-            reachable.append(fn)
-            for name in _referenced_names(fn):
-                target = defs.get(name)
-                if target is not None and id(target) not in seen:
-                    frontier.append(target)
-
         reported = set()
-        for fn in reachable:
+        for fn in reachable_loop_code(ctx, _WHILE_LOOP):
             for node in ast.walk(fn):
                 if isinstance(node, ast.Call) \
                         and dotted_name(node.func) in _SORT_CALLS \

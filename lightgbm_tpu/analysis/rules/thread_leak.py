@@ -15,7 +15,9 @@ inside ``lightgbm_tpu/``:
 - no reachable ``join()`` is found for the created thread:
   - ``self.x = Thread(...)`` is cleared by ``self.x.join(...)`` inside a
     cleanup method of the same class (``close`` / ``stop`` / ``shutdown``
-    / ``__exit__`` / ``__del__`` / ``join``);
+    / ``__exit__`` / ``__del__`` / ``join``), or by a cleanup method
+    handing ``self.x`` to a helper — same file or across an import, via
+    the whole-package call graph — that join()s the parameter;
   - ``t = Thread(...)`` (local) is cleared by ``t.join(...)`` anywhere in
     the same function (the loadgen pattern: start workers, join them);
   - an unassigned ``Thread(...).start()`` has nothing to join and always
@@ -74,6 +76,47 @@ def _joined_self_attrs(cls: ast.ClassDef) -> Set[str]:
     return out
 
 
+def _raw_params(fn: ast.FunctionDef):
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _delegated_join_attrs(ctx, cls: ast.ClassDef) -> Set[str]:
+    """Attrs ``x`` whose cleanup method hands ``self.x`` to a helper that
+    join()s the corresponding parameter — ``close()`` calling
+    ``drain_worker(self._thread)`` where ``drain_worker`` (same file or
+    across an import, via the package call graph) does ``t.join()``."""
+    index = getattr(ctx, "package", None)
+    if index is None:
+        return set()
+    mod = index.by_path.get(ctx.rel)
+    if mod is None:
+        return set()
+    out: Set[str] = set()
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if item.name not in _CLEANUP_METHODS:
+            continue
+        for node in ast.walk(item):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if not callee or callee.startswith("self."):
+                continue
+            passed = [(i, _self_attr(a)) for i, a in enumerate(node.args)
+                      if _self_attr(a)]
+            if not passed:
+                continue
+            for _, helper in index.resolve(mod, callee):
+                params = _raw_params(helper)
+                joined = _joined_locals(helper)
+                for i, attr in passed:
+                    if i < len(params) and params[i] in joined:
+                        out.add(attr)
+    return out
+
+
 def _joined_locals(fn: ast.FunctionDef) -> Set[str]:
     """Local names ``t`` with ``t.join(...)`` anywhere in ``fn``."""
     out: Set[str] = set()
@@ -127,6 +170,7 @@ def _thread_bound_names(fn: ast.FunctionDef) -> Set[str]:
 
 class ThreadLeakRule:
     rule_id = RULE_ID
+    cross_module = True   # join delegation resolves through the call graph
     summary = ("threading.Thread created without daemon=True or a "
                "reachable join() in a close()/__exit__-style cleanup — "
                "the worker outlives its owner (leak / shutdown hang)")
@@ -176,7 +220,8 @@ class ThreadLeakRule:
             elif isinstance(tgt, ast.Name):
                 target_name = tgt.id
         if target_attr and cls is not None and \
-                target_attr in _joined_self_attrs(cls):
+                (target_attr in _joined_self_attrs(cls)
+                 or target_attr in _delegated_join_attrs(ctx, cls)):
             return None
         if target_name and fn is not None and \
                 target_name in _joined_locals(fn):

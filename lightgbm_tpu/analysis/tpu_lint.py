@@ -44,9 +44,11 @@ class Finding:
     col: int
     message: str
     snippet: str       # stripped source line (baseline fingerprint)
+    severity: str = "error"   # "error" gates exit code; "warn" reports only
 
     def format(self) -> str:
-        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+        tag = "" if self.severity == "error" else f" [{self.severity}]"
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule}{tag} "
                 f"{self.message}\n    {self.snippet}")
 
 
@@ -59,6 +61,7 @@ class FileContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = None  # ast.Module, set by lint_file
+        self.package = None  # rules.common.PackageIndex, set by lint_paths
 
     def snippet(self, line: int) -> str:
         if 1 <= line <= len(self.lines):
@@ -150,21 +153,15 @@ def _iter_py_files(paths: Iterable[str]) -> Iterable[str]:
                         yield os.path.join(root, name)
 
 
-def lint_file(path: str, rel: str = None, rules=None
-              ) -> Tuple[List[Finding], Optional[str]]:
-    """Lint one file. Returns (findings, parse_error)."""
-    from .rules import active_rules
+def _parse_source(path: str, rel: str, source: str) -> FileContext:
     import ast
+    ctx = FileContext(path, rel, source)
+    ctx.tree = ast.parse(source, filename=path)
+    return ctx
 
-    rules = rules if rules is not None else active_rules()
-    rel = rel if rel is not None else os.path.relpath(path)
-    try:
-        with open(path, "r", encoding="utf-8") as fh:
-            source = fh.read()
-        ctx = FileContext(path, rel, source)
-        ctx.tree = ast.parse(source, filename=path)
-    except (OSError, SyntaxError, ValueError) as e:
-        return [], f"{rel}: cannot parse: {e}"
+
+def _check_ctx(ctx: FileContext, rules) -> List[Finding]:
+    """Run ``rules`` over one parsed file, applying pragma suppression."""
     file_off = _file_disabled_rules(ctx)
     findings = []
     for rule in rules:
@@ -174,17 +171,82 @@ def lint_file(path: str, rel: str = None, rules=None
             if not _inline_disabled(ctx, f):
                 findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings, None
+    return findings
 
 
-def lint_paths(paths: Iterable[str], rules=None
+def lint_file(path: str, rel: str = None, rules=None
+              ) -> Tuple[List[Finding], Optional[str]]:
+    """Lint one file standalone (same-file reachability semantics).
+    Returns (findings, parse_error)."""
+    from .rules import active_rules
+
+    rules = rules if rules is not None else active_rules()
+    rel = rel if rel is not None else os.path.relpath(path)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        ctx = _parse_source(path, rel, source)
+    except (OSError, SyntaxError, ValueError) as e:
+        return [], f"{rel}: cannot parse: {e}"
+    return _check_ctx(ctx, rules), None
+
+
+def lint_paths(paths: Iterable[str], rules=None, cache=None
                ) -> Tuple[List[Finding], List[str]]:
-    findings, errors = [], []
+    """Lint a file set as one package: every file is parsed first, a
+    whole-package call graph (``rules.common.PackageIndex``) is built and
+    attached as ``ctx.package``, then rules run — so R007/R009/R012 see
+    cross-module reachability. ``cache`` (a ``lint_cache.LintCache``) skips
+    re-parsing when content hashes are unchanged."""
+    from .rules import active_rules
+    from .rules.common import PackageIndex
+
+    rules = rules if rules is not None else active_rules()
+    sources, errors = [], []
     for path in _iter_py_files(paths):
-        fs, err = lint_file(path, rules=rules)
-        findings.extend(fs)
-        if err:
-            errors.append(err)
+        rel = os.path.relpath(path).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                sources.append((path, rel, fh.read()))
+        except OSError as e:
+            errors.append(f"{rel}: cannot parse: {e}")
+
+    if cache is not None and not errors:
+        hit = cache.replay(sources, [r.rule_id for r in rules])
+        if hit is not None:
+            return hit, errors
+
+    ctxs = []
+    for path, rel, source in sources:
+        try:
+            ctxs.append(_parse_source(path, rel, source))
+        except (SyntaxError, ValueError) as e:
+            errors.append(f"{rel}: cannot parse: {e}")
+
+    index = PackageIndex.build([(c.path, c.rel, c.tree) for c in ctxs])
+    local_rules = [r for r in rules
+                   if not getattr(r, "cross_module", False)]
+    cross_rules = [r for r in rules if getattr(r, "cross_module", False)]
+
+    findings: List[Finding] = []
+    per_file = {}
+    for ctx in ctxs:
+        ctx.package = index
+        if cache is not None:
+            cached_local = cache.cached_local(
+                ctx.rel, ctx.source, [r.rule_id for r in rules])
+            local = cached_local if cached_local is not None \
+                else _check_ctx(ctx, local_rules)
+        else:
+            local = _check_ctx(ctx, local_rules)
+        cross = _check_ctx(ctx, cross_rules)
+        per_file[ctx.rel] = (ctx.source, local, cross)
+        findings.extend(local)
+        findings.extend(cross)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if cache is not None and not errors:
+        cache.store(sources, [r.rule_id for r in rules], per_file)
     return findings, errors
 
 
@@ -198,27 +260,71 @@ def _resolve_baseline(arg: Optional[str], no_baseline: bool) -> Optional[str]:
     return DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None
 
 
+def stale_baseline_entries(baseline: "Baseline",
+                           linted_rels) -> List[Tuple[tuple, int]]:
+    """Baseline entries that matched nothing this run and whose file was
+    either linted (so the finding demonstrably no longer exists) or is gone
+    from disk. Entries for files outside a subset-path run are left alone —
+    a `tpu-lint some/dir` invocation can't prove anything about the rest of
+    the tree."""
+    linted = set(linted_rels)
+    stale = []
+    for key, remaining in sorted(baseline._unused.items()):
+        if remaining <= 0:
+            continue
+        rel = key[0]
+        if rel in linted or not os.path.exists(rel):
+            stale.append((key, remaining))
+    return stale
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     from .rules import active_rules
 
     ap = argparse.ArgumentParser(
         prog="python -m lightgbm_tpu.analysis",
-        description="tpu-lint: JAX/TPU hygiene analyzer (rules R001-R012)")
+        description="tpu-lint: JAX/TPU hygiene analyzer — AST tier (rules "
+                    "R001-R012) and trace tier (--trace: jaxpr/HLO "
+                    "contracts T001-...)")
     ap.add_argument("paths", nargs="*", default=["lightgbm_tpu"],
                     help="files or directories to lint")
+    ap.add_argument("--trace", action="store_true",
+                    help="run the trace-contract tier (jaxpr/HLO program "
+                         "contracts) instead of the AST tier")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help=f"suppressions baseline (default: {DEFAULT_BASELINE} "
-                         "in the current directory, when present)")
+                         "in the current directory, when present; the trace "
+                         "tier defaults to trace_lint_baseline.json)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore any baseline file")
     ap.add_argument("--write-baseline", nargs="?", const=DEFAULT_BASELINE,
                     default=None, metavar="FILE",
                     help="write current findings as the new baseline and exit 0")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="regenerate the default baseline file in place "
+                         "(tpu_lint_baseline.json, or the trace baseline "
+                         "under --trace) and exit 0")
     ap.add_argument("--select", default=None, metavar="R001,R004",
-                    help="run only these rule ids")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+                    help="run only these rule ids (or contract ids under "
+                         "--trace)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the incremental AST cache "
+                         "(.tpu_lint_cache.json)")
+    ap.add_argument("--cache-file", default=None, metavar="FILE",
+                    help="incremental cache location (default: "
+                         ".tpu_lint_cache.json in the current directory)")
+    ap.add_argument("--load", action="append", default=[], metavar="PYFILE",
+                    help="(trace tier) exec extra contract-registration "
+                         "files before running — used to plant fixture "
+                         "violations in tests")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.trace:
+        from .trace_lint import run_trace
+        return run_trace(args)
 
     rules = active_rules()
     if args.list_rules:
@@ -234,17 +340,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         rules = [r for r in rules if r.rule_id in wanted]
 
-    findings, errors = lint_paths(args.paths, rules=rules)
+    cache = None
+    if not args.no_cache:
+        from .lint_cache import LintCache, DEFAULT_CACHE
+        cache = LintCache(args.cache_file or DEFAULT_CACHE)
+
+    findings, errors = lint_paths(args.paths, rules=rules, cache=cache)
     for err in errors:
         print(f"tpu-lint: {err}", file=sys.stderr)
 
+    if args.update_baseline:
+        args.write_baseline = args.baseline or DEFAULT_BASELINE
     if args.write_baseline:
         Baseline.from_findings(findings).dump(args.write_baseline)
         print(f"tpu-lint: wrote {len(findings)} finding(s) to "
               f"{args.write_baseline}")
         return 0
 
+    linted_rels = {os.path.relpath(p).replace(os.sep, "/")
+                   for p in _iter_py_files(args.paths)}
     baseline_path = _resolve_baseline(args.baseline, args.no_baseline)
+    stale = []
     if baseline_path:
         try:
             baseline = Baseline.load(baseline_path)
@@ -253,16 +369,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
         findings = [f for f in findings if not baseline.suppresses(f)]
+        stale = stale_baseline_entries(baseline, linted_rels)
 
     if args.format == "json":
-        print(json.dumps({"findings": [asdict(f) for f in findings],
-                          "errors": errors}, indent=1))
+        print(json.dumps(
+            {"findings": [asdict(f) for f in findings],
+             "errors": errors,
+             "stale_baseline": [
+                 {"file": k[0], "rule": k[1], "snippet": k[2], "count": n}
+                 for k, n in stale]}, indent=1))
+    elif args.format == "sarif":
+        from .sarif import render
+        print(render(findings, "tpu-lint", rules=rules, errors=errors))
     else:
         for f in findings:
             print(f.format())
+        for (frel, rule, snippet), n in stale:
+            print(f"{frel}: stale baseline entry for {rule} "
+                  f"(x{n}) no longer matches any finding: {snippet!r} — "
+                  f"remove it or run --update-baseline")
         n = len(findings)
         suffix = f" (baseline: {baseline_path})" if baseline_path else ""
-        print(f"tpu-lint: {n} finding(s){suffix}")
+        print(f"tpu-lint: {n} finding(s){suffix}"
+              + (f", {len(stale)} stale baseline entrie(s)" if stale else ""))
     if errors:
         return 2
-    return 1 if findings else 0
+    return 1 if findings or stale else 0

@@ -70,6 +70,10 @@ class ValidSet(MetadataDuckTyping):
         self.Xmiss: Optional[jnp.ndarray] = None
 
 
+from ..analysis.contracts.registry import trace_entry
+
+
+@trace_entry("train_step.fused")
 class GBDT:
     """Boosting driver (reference class GBDT, src/boosting/gbdt.h:25)."""
 
@@ -1172,7 +1176,8 @@ class GBDT:
             if self.linear_tree else None
         return consts, tuple(vs.Xb for vs in self.valid_sets)
 
-    def _make_step(self, custom_grads: bool = False, batch: int = 1):
+    def _make_step(self, custom_grads: bool = False, batch: int = 1,
+                   donate_override: Optional[tuple] = None):
         assert not (custom_grads and batch > 1), \
             "custom gradients need a host round-trip per tree"
         spec = self.spec
@@ -1330,8 +1335,16 @@ class GBDT:
         # grower's per-tree leaf state and histogram cache live inside the
         # while_loop carry, which XLA already aliases in place. CPU ignores
         # donation with a warning, so gate it.
-        donate = () if self.pctx.devices[0].platform == "cpu" else \
-            ((2, 3, 4) if self.bagging_on else (2, 3))
+        # donate_override exists for the trace-contract tier
+        # (analysis/contracts): the CPU gate would make the donation
+        # contract vacuous on the dev box, so the contract compiles the
+        # step with the TPU-style donate set forced on and checks the
+        # aliases in the HLO header instead of trusting this branch.
+        if donate_override is not None:
+            donate = tuple(donate_override)
+        else:
+            donate = () if self.pctx.devices[0].platform == "cpu" else \
+                ((2, 3, 4) if self.bagging_on else (2, 3))
         return jax.jit(step, donate_argnums=donate)
 
     def _dispatch_prep(self, shrinkage: float):

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .analysis.contracts.registry import trace_entry
 from .ops.histogram import build_histograms, root_sums, table_lookup
 from .ops.split_finder import SplitCandidates, leaf_output
 from .robustness import allowed_host_sync
@@ -502,6 +503,7 @@ def _apply_wave_splits(state: GrowState, new_hist: jnp.ndarray,
     return state2, table, map_mask, p, q, n_apply
 
 
+@trace_entry("routing.bundle_space")
 def _route_rows(X: jnp.ndarray, lid: jnp.ndarray, table: jnp.ndarray,
                 map_mask: Optional[jnp.ndarray], spec: "GrowerSpec",
                 bundle: Optional[BundleDecode], default_bin: jnp.ndarray):
@@ -559,6 +561,7 @@ def _route_rows(X: jnp.ndarray, lid: jnp.ndarray, table: jnp.ndarray,
     return leaf_id, f_row, go_left, right_row
 
 
+@trace_entry("grower.wave_body")
 def grow_tree(
     X: jnp.ndarray,               # [N, F] bin codes ([N, G] bundled under EFB)
     grad: jnp.ndarray,            # [N] f32, bagging/padding-masked
@@ -887,6 +890,7 @@ def grow_tree(
 # Out-of-core streamed growth (tpu_residency=stream; ops/stream.py)
 # ======================================================================
 
+@trace_entry("grower.stream_legs")
 class StreamedGrower:
     """Host-driven out-of-core twin of :func:`grow_tree`.
 

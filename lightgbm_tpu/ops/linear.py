@@ -41,6 +41,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..analysis.contracts.registry import trace_entry
 from .histogram import table_lookup
 
 
@@ -131,6 +132,7 @@ def _gather_leaf_values(Xraw: jnp.ndarray, Xmiss: jnp.ndarray,
     return vals, miss
 
 
+@trace_entry("linear.moments")
 def accumulate_leaf_moments(Xraw, Xmiss, leaf_id, leaf_feat, g, h, included,
                             chunk_rows: int):
     """Per-leaf normal-equation moments, chunked like the histogram build.
@@ -230,6 +232,7 @@ def solve_leaf_models(XTHX, XTg, leaf_feat, nfeat, has_cat, cnt,
     return leaf_const, leaf_coeff, leaf_feat_out, n_degraded
 
 
+@trace_entry("linear.fit_leg")
 def fit_linear_leaves(tree, Xraw, Xmiss, leaf_id, g, h, included, is_cat,
                       *, max_features: int, linear_lambda: float,
                       chunk_rows: int, max_steps: int):

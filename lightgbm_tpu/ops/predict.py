@@ -13,6 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..analysis.contracts.registry import trace_entry
 from ..grower import TreeArrays, decode_bundled_bin
 from .histogram import table_lookup
 
@@ -295,6 +296,7 @@ class StackedForest:
         return codes
 
 
+@trace_entry("predict.forest_walk")
 def forest_walk_leaves(split_feature, thr_rank, decision, left, right,
                        root_is_leaf, zero_rank, codes, is_nan, is_zero):
     """Leaf index [N, T] for every (row, tree); integer-exact traversal.

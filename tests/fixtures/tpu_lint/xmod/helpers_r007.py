@@ -1,0 +1,14 @@
+"""Cross-module fixture (R007): the argsort lives HERE, the while_loop
+that reaches it lives in loops_r007.py — same-file reachability would
+never connect them."""
+import jax.numpy as jnp
+
+
+def regroup(lid):
+    key = jnp.where(lid >= 0, lid, jnp.int32(2 ** 30))
+    return jnp.argsort(key, stable=True)     # R007 via cross-module reach
+
+
+def harmless(lid):
+    # identical sort, NOT reachable from any loop body — must stay clean
+    return jnp.argsort(lid)

@@ -1,0 +1,10 @@
+"""Cross-module fixture (R009): the device_put lives HERE, the scan body
+that reaches it lives in loops_r009.py via `import helpers_r009`."""
+import jax
+import numpy as np
+
+SHARDS = [np.zeros((8, 4), np.uint8)]
+
+
+def load(i):
+    return jax.device_put(SHARDS[0])     # R009 via cross-module reach

@@ -1,0 +1,12 @@
+"""Cross-module fixture (R012): join helpers other modules delegate
+thread cleanup to."""
+
+
+def stop_thread(worker, timeout=2.0):
+    if worker is not None:
+        worker.join(timeout=timeout)
+
+
+def forget_thread(worker):
+    # does NOT join — delegating cleanup here must not credit a join
+    return worker

@@ -1,0 +1,31 @@
+"""Cross-module fixture (R012): non-daemon threads whose close() delegates
+the join to helpers_r012. `Delegated` must lint clean (stop_thread joins
+its positional parameter); `Leaky` must still fire (forget_thread never
+joins)."""
+import threading
+
+from helpers_r012 import forget_thread, stop_thread
+
+
+class Delegated:
+    def start(self):
+        self._worker = threading.Thread(target=self._run)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        stop_thread(self._worker)
+
+
+class Leaky:
+    def start(self):
+        self._worker = threading.Thread(target=self._run)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        forget_thread(self._worker)

@@ -1,0 +1,18 @@
+"""Cross-module fixture (R007): hosts the while_loop whose body calls
+helpers_r007.regroup through a module-level from-import."""
+import jax
+import jax.numpy as jnp
+
+from helpers_r007 import regroup
+
+
+def grow(state):
+    def cond(s):
+        return s[0] < 4
+
+    def body(s):
+        i, lid = s
+        order = regroup(lid)
+        return i + 1, jnp.take(lid, order)
+
+    return jax.lax.while_loop(cond, body, state)

@@ -1,0 +1,15 @@
+"""Cross-module fixture (R009): scan body calls a helper module's
+device_put through a plain module import + attribute call."""
+import jax
+import jax.numpy as jnp
+
+import helpers_r009
+
+
+def fold_shards(acc):
+    def body(carry, i):
+        shard = helpers_r009.load(i)
+        return carry + jnp.sum(shard), ()
+
+    out, _ = jax.lax.scan(body, acc, jnp.arange(4))
+    return out

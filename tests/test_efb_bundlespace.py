@@ -276,53 +276,30 @@ def test_planted_tie_on_bundle_member_boundary():
 
 
 # ------------------------------------------------- routing jaxpr inspection
+# The routing gather pin lives in the trace-contract registry (contract
+# T002, analysis/contracts/entries.py) — this test asserts THROUGH the
+# registry, so the test and `python -m lightgbm_tpu.analysis --trace`
+# check the same predicate via one implementation.
 
-def _jaxpr_has_primitive(jaxpr, name: str) -> bool:
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == name:
-            return True
-        for v in eqn.params.values():
-            for j in (v if isinstance(v, (list, tuple)) else [v]):
-                inner = getattr(j, "jaxpr", None)
-                if inner is not None and _jaxpr_has_primitive(inner, name):
-                    return True
-                if hasattr(j, "eqns") and _jaxpr_has_primitive(j, name):
-                    return True
-    return False
-
-
-@pytest.mark.parametrize("efb_unpack,expect_gather", [(False, False),
-                                                      (True, True)])
-def test_routing_jaxpr_gather_presence(efb_unpack, expect_gather):
+@pytest.mark.parametrize("shape_class,expect_gather",
+                         [("bundled", False), ("bundled_unpack", True)])
+def test_routing_jaxpr_gather_presence(shape_class, expect_gather):
     """The native routing pass must contain NO gather primitive at all —
     the split's bundle coordinates ride the one-hot routing table and the
     code compare is a one-hot multiply-sum; the legacy arm keeps the
     per-row decode_bundled_bin take_along_axis (a gather). This is the
     jaxpr pin that the [F, B] unpack-table gather never returns to the
     routing hot path."""
-    import jax
-    import jax.numpy as jnp
+    from lightgbm_tpu.analysis.contracts import (CONTRACTS, build_program,
+                                                 evaluate)
+    from lightgbm_tpu.analysis.contracts import jaxpr_utils as ju
+    import lightgbm_tpu.analysis.contracts.entries  # noqa: F401
 
-    from lightgbm_tpu.grower import BundleDecode, GrowerSpec, _route_rows
-
-    N, G, F, B, Bb = 64, 3, 8, 8, 16
-    spec = GrowerSpec(
-        num_leaves=7, num_features=F, num_bins_padded=B, chunk_rows=32,
-        hist_slots=3, wave_size=3, max_depth=-1, lambda_l1=0.0,
-        lambda_l2=0.0, min_data_in_leaf=1.0, min_sum_hessian_in_leaf=0.0,
-        min_gain_to_split=0.0, efb_unpack=efb_unpack)
-    bundle = BundleDecode(
-        col=jnp.zeros(F, jnp.int32), lo=jnp.ones(F, jnp.int32),
-        hi=jnp.full(F, 2, jnp.int32), off=jnp.zeros(F, jnp.int32),
-        unpack_bin=jnp.zeros((F, B), jnp.int32),
-        code_feat=jnp.zeros((G, Bb), jnp.int32))
-    n_cols = 6 if efb_unpack else 11
-    jx = jax.make_jaxpr(
-        lambda X, lid, table, db: _route_rows(X, lid, table, None, spec,
-                                              bundle, db))(
-        jnp.zeros((N, G), jnp.uint8), jnp.zeros(N, jnp.int32),
-        jnp.zeros((8, n_cols), jnp.int32), jnp.zeros(F, jnp.int32))
-    assert _jaxpr_has_primitive(jx.jaxpr, "gather") == expect_gather
+    program = build_program("routing.bundle_space", shape_class)
+    assert ju.has_primitive(program.jaxpr, "gather") == expect_gather
+    c = CONTRACTS["T002"]
+    t = next(t for t in c.targets if t.shape_class == shape_class)
+    assert evaluate(c, t, program) == []
 
 
 # -------------------------------------------------- collective byte estimates

@@ -59,46 +59,29 @@ def _assert_identical(b1, b2, X):
 
 
 # ---------------------------------------------------------------- jaxpr pin
+# The wave-loop sort pin lives in the trace-contract registry (contract
+# T001, analysis/contracts/entries.py) — this test asserts THROUGH the
+# registry, so the test and `python -m lightgbm_tpu.analysis --trace`
+# check the same predicate via one implementation.
 
-def _jaxpr_has_sort(jaxpr) -> bool:
-    """Recursively walk a (Closed)Jaxpr for the `sort` primitive — covers
-    sub-jaxprs carried in eqn params (while_loop/cond/scan bodies)."""
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "sort":
-            return True
-        for v in eqn.params.values():
-            for j in (v if isinstance(v, (list, tuple)) else [v]):
-                inner = getattr(j, "jaxpr", None)
-                if inner is not None and _jaxpr_has_sort(inner):
-                    return True
-                if hasattr(j, "eqns") and _jaxpr_has_sort(j):
-                    return True
-    return False
-
-
-@pytest.mark.parametrize("incremental,expect_sort", [(True, False),
-                                                     (False, True)])
-def test_wave_loop_jaxpr_sort_presence(incremental, expect_sort):
+@pytest.mark.parametrize("shape_class,expect_sort",
+                         [("serial", False), ("serial_legacy", True)])
+def test_wave_loop_jaxpr_sort_presence(shape_class, expect_sort):
     """The steady-state wave body carries NO sort op on the incremental
     path; the legacy path still does — proving both the tentpole claim and
     the sensitivity of this very inspection."""
-    N, F, B, L = 1024, 6, 16, 15
-    rng = np.random.RandomState(0)
-    X = jnp.asarray(rng.randint(0, B, size=(N, F)).astype(np.uint8))
-    g = jnp.asarray(rng.randn(N).astype(np.float32))
-    ones = jnp.ones(N, jnp.float32)
-    nb = jnp.full(F, B, jnp.int32)
-    zeros_f = jnp.zeros(F, jnp.int32)
-    spec = GrowerSpec(num_leaves=L, num_features=F, num_bins_padded=B,
-                      chunk_rows=256, hist_slots=4, wave_size=4, max_depth=0,
-                      lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=5.0,
-                      min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0,
-                      row_compact=True, incremental_partition=incremental,
-                      compact_frac=1.0)
-    jx = jax.make_jaxpr(lambda gg: grow_tree(
-        X, gg, ones, ones, jnp.ones(F, bool), jnp.zeros(F, bool), nb,
-        zeros_f, zeros_f, spec))(g)
-    assert _jaxpr_has_sort(jx.jaxpr) == expect_sort
+    from lightgbm_tpu.analysis.contracts import (CONTRACTS, build_program,
+                                                 evaluate)
+    from lightgbm_tpu.analysis.contracts import jaxpr_utils as ju
+    import lightgbm_tpu.analysis.contracts.entries  # noqa: F401
+
+    program = build_program("grower.wave_body", shape_class)
+    assert ju.has_primitive(program.jaxpr, "sort") == expect_sort
+    # and the registered contract reaches the same verdict: no findings,
+    # on the clean arm OR the violates arm (whose failure is expected)
+    c = CONTRACTS["T001"]
+    t = next(t for t in c.targets if t.shape_class == shape_class)
+    assert evaluate(c, t, program) == []
 
 
 # ------------------------------------------------------- bit-identity pins

@@ -18,6 +18,16 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 FIXDIR = os.path.join(HERE, "fixtures", "tpu_lint")
 
+
+@pytest.fixture(autouse=True)
+def _isolated_default_cache(tmp_path, monkeypatch):
+    """In-process main() calls must not read/write the repo's own
+    .tpu_lint_cache.json — debris from a real `make lint` run (different
+    rule selections) would leak into the assertions."""
+    from lightgbm_tpu.analysis import lint_cache
+    monkeypatch.setattr(lint_cache, "DEFAULT_CACHE",
+                        str(tmp_path / "lint_cache.json"))
+
 # (fixture path relative to FIXDIR, rule id it must violate)
 BAD_FIXTURES = [
     ("bad_r001.py", "R001"),
@@ -469,3 +479,134 @@ def test_syntax_error_reported_not_crash(tmp_path):
     findings, errors = lint_paths([str(p)])
     assert findings == []
     assert len(errors) == 1 and "cannot parse" in errors[0]
+
+
+# ------------------------------------------------ whole-package call graph
+
+XMOD = os.path.join(FIXDIR, "xmod")
+
+
+def test_r007_cross_module_reach():
+    """The argsort lives in helpers_r007.py, the while_loop in
+    loops_r007.py — only the package call graph connects them. The
+    identical sort NOT reachable from a loop stays clean."""
+    findings, errors = lint_paths([XMOD])
+    assert errors == []
+    r007 = [f for f in findings if f.rule == "R007"]
+    assert len(r007) == 1, [f.format() for f in findings]
+    assert r007[0].path.endswith("helpers_r007.py")
+    assert "regroup" in r007[0].message
+
+
+def test_r009_cross_module_reach():
+    findings, _ = lint_paths([XMOD])
+    r009 = [f for f in findings if f.rule == "R009"]
+    assert len(r009) == 1, [f.format() for f in findings]
+    assert r009[0].path.endswith("helpers_r009.py")
+
+
+def test_r012_cross_module_join_delegation():
+    """Delegated.close() hands self._worker to helpers_r012.stop_thread,
+    which joins its parameter — credited through the call graph, clean.
+    Leaky delegates to a helper that never joins — still fires."""
+    findings, _ = lint_paths([XMOD])
+    r012 = [f for f in findings if f.rule == "R012"]
+    assert len(r012) == 1, [f.format() for f in findings]
+    assert r012[0].path.endswith("workers_r012.py")
+    # the one finding is Leaky's thread, not Delegated's
+    src = open(os.path.join(XMOD, "lightgbm_tpu", "workers_r012.py")).read()
+    leaky_at = src[:src.index("class Leaky")].count("\n") + 1
+    assert r012[0].line > leaky_at
+
+
+def test_cross_module_rules_need_package_context():
+    """Standalone single-file lint (same-file semantics) cannot see the
+    loop in the other module — the helper lints clean alone, which is
+    exactly why lint_paths builds the package index."""
+    findings, err = lint_file(os.path.join(XMOD, "helpers_r007.py"))
+    assert err is None and findings == []
+
+
+# ---------------------------------------------------------- incremental cache
+
+def test_cache_replays_without_reparsing(tmp_path):
+    from unittest import mock
+
+    from lightgbm_tpu.analysis.lint_cache import LintCache
+
+    p = tmp_path / "mod.py"
+    p.write_text("import jax.numpy as jnp\nA = jnp.arange(4)\n")
+    cache_path = str(tmp_path / "cache.json")
+    first, _ = lint_paths([str(p)], cache=LintCache(cache_path))
+    assert len(first) == 1
+
+    with mock.patch("lightgbm_tpu.analysis.tpu_lint._parse_source",
+                    side_effect=AssertionError("cache miss re-parsed")):
+        replayed, _ = lint_paths([str(p)], cache=LintCache(cache_path))
+    assert [f.__dict__ for f in replayed] == [f.__dict__ for f in first]
+
+
+def test_cache_invalidated_by_content_and_rule_changes(tmp_path):
+    from lightgbm_tpu.analysis.lint_cache import LintCache
+
+    p = tmp_path / "mod.py"
+    p.write_text("import jax.numpy as jnp\nA = jnp.arange(4)\n")
+    cache_path = str(tmp_path / "cache.json")
+    lint_paths([str(p)], cache=LintCache(cache_path))
+
+    # content change: full pipeline runs again, new finding appears
+    p.write_text("import jax.numpy as jnp\nA = jnp.arange(4)\n"
+                 "B = jnp.zeros(8)\n")
+    findings, _ = lint_paths([str(p)], cache=LintCache(cache_path))
+    assert len(findings) == 2
+
+    # rule-list change: fingerprint matches but the rule ids don't — the
+    # cached replay must refuse
+    cache = LintCache(cache_path)
+    sources = [(str(p), os.path.relpath(str(p)).replace(os.sep, "/"),
+                p.read_text())]
+    assert cache.replay(sources, ["R006"]) is None
+
+
+# --------------------------------------------- stale baseline + update CLI
+
+def test_stale_baseline_entry_fails_lint(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("import jax.numpy as jnp\nA = jnp.arange(4)\n")
+    base = tmp_path / "base.json"
+    rc = main([str(p), "--no-cache", "--baseline", str(base),
+               "--update-baseline"])
+    assert rc == 0 and base.exists()
+    rc = main([str(p), "--no-cache", "--baseline", str(base)])
+    assert rc == 0
+
+    # fix the finding: the baseline entry now matches nothing -> stale
+    p.write_text("import jax.numpy as jnp\n\n\ndef f(x):\n"
+                 "    return jnp.arange(4)\n")
+    rc = main([str(p), "--no-cache", "--baseline", str(base)])
+    assert rc == 1
+
+    # --update-baseline clears the stale entry
+    rc = main([str(p), "--no-cache", "--baseline", str(base),
+               "--update-baseline"])
+    assert rc == 0
+    rc = main([str(p), "--no-cache", "--baseline", str(base)])
+    assert rc == 0
+
+
+def test_stale_entries_ignored_for_unlinted_files(tmp_path):
+    """A subset-path run proves nothing about files it did not lint —
+    their baseline entries must not be reported stale."""
+    from lightgbm_tpu.analysis.tpu_lint import stale_baseline_entries
+
+    a = tmp_path / "a.py"
+    a.write_text("import jax.numpy as jnp\nA = jnp.arange(4)\n")
+    findings, _ = lint_paths([str(a)])
+    bl = Baseline.from_findings(findings)
+    # 'a.py' entry unconsumed, but a.py was NOT in this (empty) run and
+    # still exists on disk -> not stale
+    rel = findings[0].path
+    assert stale_baseline_entries(bl, linted_rels=set()) == []
+    # linted this run without consuming the entry -> stale
+    assert [k for k, _ in stale_baseline_entries(bl, {rel})] == [
+        (rel, findings[0].rule, findings[0].snippet)]
