@@ -11,7 +11,7 @@
 PYTEST := python -m pytest -q
 
 # Static JAX/TPU hygiene, both tiers (docs/Static-Analysis.md):
-#   1. AST tier  — rules R001-R012 over the package source with the
+#   1. AST tier  — rules R001-R013 over the package source with the
 #      whole-package call graph; findings gate unless covered by
 #      tpu_lint_baseline.json.
 #   2. trace tier — contracts T001+ over the SHIPPED entry points' jaxprs
@@ -45,6 +45,8 @@ verify: lint
 	$(MAKE) linear
 	$(MAKE) serve
 	$(MAKE) serve-chaos
+	env JAX_PLATFORMS=cpu LGBM_TPU_CHAOS_DIST_FAST=1 \
+	    LGBM_TPU_CHAOS_SEED=1234 python bench.py --chaos-dist
 	$(MAKE) bench-diff
 
 # Out-of-core streaming smoke (docs/TPU-Performance.md "Out-of-core
@@ -147,6 +149,20 @@ chaos:
 bench-chaos:
 	python bench.py --chaos
 
+# Distributed fault-tolerance matrix (docs/Fault-Tolerance.md "Distributed
+# fault tolerance"): heartbeat-lease expiry (detection latency p50/p99),
+# KV flap during init_distributed (reset + rejoin on attempt 2),
+# manifest-vs-shard mismatch (whole-gang one-epoch fallback, --verify exit
+# 2), kill -9 of one rank in a REAL 2-process jax.distributed gang
+# (survivor exits 145 naming the peer, FleetSupervisor relaunches,
+# bit-identical model, measured fleet MTTR), and the elastic 8->4 shrink
+# (loud refusal without tpu_reshard_on_resume; bit-identical to a fresh
+# 4-device resume with it). The FAST subset (first three arms) rides
+# `make verify`. Bank with LGBM_TPU_CHAOS_DIST_OUT=CHAOS_DIST_r<N>.json.
+chaos-dist:
+	env JAX_PLATFORMS=cpu LGBM_TPU_CHAOS_SEED=1234 \
+	    LGBM_TPU_COMM_JITTER_SEED=1234 python bench.py --chaos-dist
+
 check-fast:
 	$(PYTEST) tests/test_parallel.py tests/test_wave_parity.py \
 	          tests/test_engine.py::test_binary tests/test_engine.py::test_regression \
@@ -171,5 +187,5 @@ trace:
 	@echo "trace: $$(ls -1t .telemetry/trace_*.json | head -1)"
 
 .PHONY: lint verify check-fast check capi bench-cpu chaos bench-chaos \
-        trace bench-diff ledger multichip stream serve serve-chaos sparse \
-        linear
+        chaos-dist trace bench-diff ledger multichip stream serve \
+        serve-chaos sparse linear

@@ -1364,12 +1364,34 @@ def run_smoke():
 
         wd = HangWatchdog(timeout_s=3600.0, action="dump",
                           dump_dir=tel_dir)
+        from lightgbm_tpu.robustness import distributed as _gdist
+        from lightgbm_tpu.robustness.chaos import FakeKVStore
         try:
             base_rep_r, t_off, _ = _guarded_loop(
                 "smoke-robustness-off", False, None)
             wd.start()
+            # gang protocol armed for the ON arm (r17 acceptance: the
+            # smoke stays 0-recompile/0-host-sync with heartbeat-lease
+            # beats per dispatch AND the gang manifest commit on save) —
+            # a FakeKVStore-backed 1-rank gang: every KV set/get is
+            # host-only, so the guard proves the protocol adds no device
+            # traffic
+            _kv = FakeKVStore()
+            _gdist.install_gang_override(_kv, rank=0, world=1)
+            lease = _gdist.HeartbeatLease(
+                client=_kv, rank=0, world=1,
+                lease_timeout_s=30.0, interval_s=0.0)
+            lease.beat(force=True)
+
+            def _beat_all():
+                wd.beat()
+                lease.beat()
             rep_r, t_on, rob_ckpt_s = _guarded_loop(
-                "smoke-robustness-on", True, wd.beat)
+                "smoke-robustness-on", True, _beat_all)
+            if not _gdist.list_manifests(ck_dir_r):
+                raise RuntimeError(
+                    "gang override was live but save_checkpoint committed "
+                    "no epoch manifest — the gang path did not engage")
             rob_ckpt_s = round(rob_ckpt_s, 4)
             rob_misses = rep_r["post_warmup_cache_misses"]
             rob_syncs = rep_r["host_syncs"]
@@ -1377,14 +1399,16 @@ def run_smoke():
                 else None
             if rob_misses:
                 raise RuntimeError(
-                    f"fused step recompiled with the watchdog + checkpoint "
-                    f"checksums armed: {rob_misses} post-warm-up miss(es)")
+                    f"fused step recompiled with the watchdog + heartbeat "
+                    f"lease + gang checkpoint armed: {rob_misses} "
+                    f"post-warm-up miss(es)")
             if rob_syncs > base_rep_r["host_syncs"]:
                 raise RuntimeError(
                     f"the robustness layer added host syncs inside the "
                     f"fused loop: {rob_syncs} vs baseline "
                     f"{base_rep_r['host_syncs']}")
         finally:
+            _gdist.uninstall_gang_override()
             wd.stop()
             shutil.rmtree(ck_dir_r, ignore_errors=True)
     except Exception as e:            # noqa: BLE001 — any failure fails CI
@@ -2611,6 +2635,400 @@ def run_chaos(argv=None):
     return 0 if ok else 1
 
 
+def run_chaos_dist(argv=None):
+    """`bench.py --chaos-dist`: the DISTRIBUTED fault-tolerance matrix
+    (docs/Fault-Tolerance.md "Distributed fault tolerance"). Hermetic CPU;
+    gangs are real multi-process jax.distributed clusters or multi-threaded
+    FakeKVStore simulations — deterministic either way. The arms:
+
+    1. LEASE EXPIRY — a peer rank beats its heartbeat lease once and dies;
+       the survivor's pre-wave probe must raise PeerLostError NAMING rank 1
+       within the lease deadline. Detection latency p50/p99 over repeated
+       trials is banked (the detection half of fleet MTTR).
+    2. KV FLAP DURING INIT — jax.distributed.initialize loses the first
+       coordination-service handshake; init_distributed must re-run the
+       partial-init reset (shutdown/clear) and join on attempt 2, never
+       die on attempt 1.
+    3. MANIFEST/SHARD MISMATCH — a 2-rank gang commits two epochs, then
+       rank 1's newest shard rots; BOTH ranks' resolve_resume falls back a
+       FULL epoch together (shed_epochs banked; a mixed-iteration resume is
+       never attempted) and `checkpoint --verify` on the bad epoch exits 2.
+    4. KILL -9 ONE RANK MID-EPOCH (skipped under LGBM_TPU_CHAOS_DIST_FAST)
+       — a real 2-process gang trains over jax.distributed; rank 1
+       SIGKILLs itself after two manifest commits. The survivor must exit
+       145 (comm loss, not a hang), FleetSupervisor relaunches the gang
+       with resume_from=auto, and the final model is bit-identical to a
+       fault-free gang run. Fleet MTTR (failure -> first new epoch after
+       relaunch) is banked.
+    5. ELASTIC 8->4 SHRINK (skipped under FAST) — a checkpoint written at 8
+       simulated devices is resumed at 4: WITHOUT tpu_reshard_on_resume the
+       run must refuse loudly (nonzero exit); with elastic=true +
+       tpu_reshard_on_resume=true it completes, bit-identical to a second
+       fresh 4-device resume from the same epoch.
+
+    Prints ONE JSON line; exit 0 iff every arm passed. `value` is the
+    number of arms passed; LGBM_TPU_CHAOS_DIST_OUT banks the payload
+    (fleet_mttr_s / detect_p50_ms / detect_p99_ms / shed_epochs feed the
+    ledger under the |chaos_dist= comparability key)."""
+    from lightgbm_tpu.utils.hermetic import force_cpu_backend
+    force_cpu_backend()
+    import shutil
+    import socket
+    import statistics
+    import tempfile
+    import threading
+    import time
+
+    from lightgbm_tpu.robustness import distributed as gdist
+    from lightgbm_tpu.robustness.chaos import FakeKVStore
+    from lightgbm_tpu.robustness.retry import PeerLostError
+    from lightgbm_tpu.robustness.watchdog import EXIT_COMM_LOST
+
+    fast = os.environ.get("LGBM_TPU_CHAOS_DIST_FAST", "") == "1"
+    seed = int(os.environ.get("LGBM_TPU_CHAOS_SEED", "1234"))
+    work = tempfile.mkdtemp(prefix="lgbm_bench_chaosdist_")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    out = {"metric": "chaos_dist",
+           "chaos_dist": "gang2|kill9+flap+lease+manifest+shrink",
+           "platform": "cpu", "seed": seed, "fast": fast, "arms": {}}
+    ok, err = True, []
+
+    def arm(name, fn):
+        nonlocal ok
+        try:
+            out["arms"][name] = dict(fn() or {}, ok=True)
+        except Exception as e:            # noqa: BLE001 — fail the arm
+            ok = False
+            out["arms"][name] = {"ok": False,
+                                 "error": f"{type(e).__name__}: {e}"[:300]}
+            err.append(f"{name}: {type(e).__name__}: {e}")
+
+    # ---- arm 1: heartbeat lease expiry -> typed PeerLostError ----------
+    def arm_lease():
+        trials = 8 if fast else 40
+        lease_s = 0.05
+        lat = []
+        for _t in range(trials):
+            kv = FakeKVStore()
+            me = gdist.HeartbeatLease(client=kv, rank=0, world=2,
+                                      lease_timeout_s=lease_s,
+                                      interval_s=0.0, probe_timeout_ms=20)
+            peer = gdist.HeartbeatLease(client=kv, rank=1, world=2,
+                                        lease_timeout_s=lease_s,
+                                        interval_s=0.0, probe_timeout_ms=20)
+            me.beat(force=True)
+            peer.beat(force=True)          # rank 1's one and only beat
+            me.check_peers()               # observe the live lease once
+            t_dead = time.monotonic()      # ... then rank 1 'dies' NOW
+            deadline = t_dead + 5.0
+            named = None
+            while time.monotonic() < deadline:
+                try:
+                    me.beat()
+                    me.check_peers()
+                except PeerLostError as e:
+                    named = e.rank
+                    lat.append((time.monotonic() - t_dead) * 1000.0)
+                    break
+                time.sleep(0.002)
+            if named != 1:
+                raise RuntimeError(
+                    f"trial {_t}: dead peer not detected as rank 1 within "
+                    f"5s (got {named!r}) — lease_timeout_s={lease_s}")
+        lat.sort()
+        p50 = statistics.median(lat)
+        p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))]
+        out["detect_p50_ms"] = round(p50, 2)
+        out["detect_p99_ms"] = round(p99, 2)
+        return {"trials": trials, "lease_timeout_ms": lease_s * 1e3,
+                "detect_p50_ms": round(p50, 2),
+                "detect_p99_ms": round(p99, 2)}
+
+    # ---- arm 2: KV flap during init -> reset + retry, join on 2nd ------
+    def arm_kv_flap():
+        import jax
+
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.parallel import comm as _comm
+        if _comm.distributed_client() is not None:
+            raise RuntimeError("bench process unexpectedly has a live "
+                               "distributed client")
+        calls = {"init": 0, "reset": 0}
+        real_init = jax.distributed.initialize
+        real_shutdown = jax.distributed.shutdown
+
+        def flap_init(**kw):
+            calls["init"] += 1
+            if calls["init"] == 1:
+                raise RuntimeError("KV flap: coordination service dropped "
+                                   "the handshake mid-connect")
+
+        def count_shutdown():
+            calls["reset"] += 1
+
+        old_base = os.environ.get("LGBM_TPU_COMM_BACKOFF_BASE")
+        os.environ["LGBM_TPU_COMM_BACKOFF_BASE"] = "0.01"
+        jax.distributed.initialize = flap_init
+        jax.distributed.shutdown = count_shutdown
+        try:
+            cfg = Config.from_params(dict(
+                num_machines=2,
+                machines="127.0.0.1:12601,127.0.0.1:12602",
+                local_listen_port=12601, time_out=1))
+            _comm.init_distributed(cfg)
+        finally:
+            jax.distributed.initialize = real_init
+            jax.distributed.shutdown = real_shutdown
+            if old_base is None:
+                os.environ.pop("LGBM_TPU_COMM_BACKOFF_BASE", None)
+            else:
+                os.environ["LGBM_TPU_COMM_BACKOFF_BASE"] = old_base
+        if calls["init"] != 2 or calls["reset"] != 1:
+            raise RuntimeError(
+                f"expected attempt-1 failure to reset partial init and "
+                f"attempt 2 to join: init calls={calls['init']}, "
+                f"partial-init resets={calls['reset']}")
+        return {"init_attempts": calls["init"],
+                "partial_init_resets": calls["reset"]}
+
+    # ---- arm 3: manifest/shard mismatch -> gang falls back TOGETHER ----
+    def arm_manifest():
+        kv = FakeKVStore(world=2)
+        gang_dir = os.path.join(work, "gang_manifest")
+        failures = []
+
+        def one_rank(r, fn, slot, results):
+            try:
+                results[slot] = fn(gdist.GangCheckpointCoordinator(
+                    gang_dir, client=kv, rank=r, world=2,
+                    timeout_ms=30_000))
+            except Exception as e:        # noqa: BLE001 — collected below
+                failures.append(f"rank {r}: {type(e).__name__}: {e}")
+
+        def gang(fn):
+            results = [None, None]
+            ts = [threading.Thread(target=one_rank, args=(r, fn, r, results))
+                  for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            if failures:
+                raise RuntimeError("; ".join(failures))
+            return results
+
+        def save_two(co):
+            for it in (2, 4):
+                co.save({"iteration": it,
+                         "config_fingerprint": "bench-chaos-dist",
+                         "config": {"tree_learner": "data"},
+                         "state": {"n_devices": 1, "tree_learner": "data"},
+                         "model": list(range(200))})
+            return co.local_verified_epochs()
+
+        epochs = gang(save_two)
+        if epochs != [[1, 2], [1, 2]]:
+            raise RuntimeError(f"gang banked {epochs}, wanted two epochs "
+                               f"verified on both ranks")
+        # rot rank 1's NEWEST shard: the manifest's CRC no longer matches
+        bad = os.path.join(gang_dir, "shard_0000000002_r0001.pkl")
+        raw = bytearray(open(bad, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        open(bad, "wb").write(bytes(raw))
+        shards = gang(lambda co: co.resolve_resume())
+        want = [os.path.join(gang_dir, f"shard_0000000001_r{r:04d}.pkl")
+                for r in range(2)]
+        if shards != want:
+            raise RuntimeError(
+                f"gang did not fall back a FULL epoch together: resolved "
+                f"{[os.path.basename(s) if s else s for s in shards]}")
+        out["shed_epochs"] = 1             # epoch 2 known, epoch 1 resumed
+        # the --verify CLI on a dir holding ONLY the disagreeing epoch
+        # must exit 2 (manifest present, shard set does not verify)
+        bad_dir = os.path.join(work, "gang_bad_only")
+        os.makedirs(bad_dir)
+        for name in ("manifest_0000000002.json", "shard_0000000002_r0000.pkl",
+                     "shard_0000000002_r0001.pkl"):
+            shutil.copy(os.path.join(gang_dir, name),
+                        os.path.join(bad_dir, name))
+        rc = subprocess.call(
+            [sys.executable, "-m", "lightgbm_tpu.robustness.checkpoint",
+             "--verify", bad_dir],
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        if rc != 2:
+            raise RuntimeError(f"checkpoint --verify on the disagreeing "
+                               f"epoch exited {rc}, wanted 2")
+        return {"shed_epochs": 1, "verify_rc_on_bad_epoch": rc}
+
+    # ---------------------------------------------------- subprocess plumbing
+    def _free_ports(n):
+        socks, ports = [], []
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+        for s in socks:
+            s.close()
+        return ports
+
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+                     XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    child_env.setdefault("LGBM_TPU_COMPILE_CACHE_DIR",
+                         os.path.join(repo, ".jax_cache"))
+    child_py = os.path.join(repo, "tests", "chaos_dist_child.py")
+
+    # ---- arm 4: kill -9 one rank mid-epoch -> 145 + relaunch + MTTR ----
+    def arm_kill9():
+        from lightgbm_tpu.robustness.supervisor import FleetSupervisor
+
+        def gang_clean(model, ck_dir):
+            ports = _free_ports(2)
+            procs = [subprocess.Popen(
+                [sys.executable, child_py, f"rank={r}", "world=2",
+                 "ports=" + ",".join(map(str, ports)),
+                 f"checkpoint_dir={ck_dir}", f"out_model={model}",
+                 "rounds=12"], env=child_env, cwd=work)
+                for r in range(2)]
+            rcs = [p.wait(timeout=600) for p in procs]
+            if rcs != [0, 0]:
+                raise RuntimeError(f"fault-free gang run failed: {rcs}")
+
+        clean_model = os.path.join(work, "gang_clean.txt")
+        gang_clean(clean_model, os.path.join(work, "ck_gang_clean"))
+
+        ck_kill = os.path.join(work, "ck_gang_kill")
+        kill_model = os.path.join(work, "gang_kill9.txt")
+        template = ["rank={rank}", "world={world}",
+                    f"checkpoint_dir={ck_kill}", f"out_model={kill_model}",
+                    "rounds=12", "kill_rank=1", "kill_after_manifests=2",
+                    f"kill_marker={os.path.join(work, 'killed.marker')}"]
+
+        def pre_launch(world, generation):
+            return ["ports=" + ",".join(map(str, _free_ports(world)))]
+
+        def spawn(argv):
+            return subprocess.Popen([sys.executable, child_py] + list(argv),
+                                    env=child_env, cwd=work)
+
+        fleet = FleetSupervisor(template, 2, seed=seed, max_restarts=3,
+                                backoff_base_s=0.1, backoff_max_s=1.0,
+                                reap_grace_s=60.0, pre_launch_fn=pre_launch,
+                                spawn_fn=spawn)
+        rc = fleet.run()
+        rep = fleet.report()
+        if rc != 0 or fleet.restarts < 1:
+            raise RuntimeError(f"fleet did not recover: rc={rc} "
+                               f"report={rep}")
+        codes = fleet.gang_exit_codes[0]     # int rank keys (report() strs)
+        if codes.get(1) != -9:
+            raise RuntimeError(f"rank 1 was not the kill -9 culprit: "
+                               f"{codes}")
+        if codes.get(0) != EXIT_COMM_LOST:
+            raise RuntimeError(
+                f"surviving rank 0 exited {codes.get(0)}, wanted "
+                f"{EXIT_COMM_LOST} (typed comm loss naming the peer)")
+        identical = open(kill_model).read() == open(clean_model).read()
+        if not identical:
+            raise RuntimeError("recovered gang model differs from the "
+                               "fault-free gang run")
+        mttr = rep["recovery_seconds"][0] if rep["recovery_seconds"] \
+            else None
+        if mttr is None:
+            raise RuntimeError(f"fleet MTTR was not measured: {rep}")
+        out["fleet_mttr_s"] = round(mttr, 2)
+        return {"gang_exit_codes": {str(k): v for k, v in codes.items()},
+                "restarts": rep["restarts"],
+                "fleet_mttr_s": round(mttr, 2),
+                "identical_to_clean": identical}
+
+    # ---- arm 5: elastic 8->4 shrink ------------------------------------
+    def arm_shrink():
+        n_rows = 4000
+        X, y = _higgs_like(n_rows)
+        data = os.path.join(work, "shrink_train.csv")
+        with open(data, "w") as fh:
+            for i in range(n_rows):
+                fh.write(",".join([f"{y[i]:.6g}"]
+                                  + [f"{v:.6g}" for v in X[i]]) + "\n")
+        ck = os.path.join(work, "ck_shrink")
+
+        def cli(extra, devices, model):
+            env = dict(child_env,
+                       XLA_FLAGS="--xla_force_host_platform_device_count="
+                                 + str(devices))
+            # tree_learner=data so the mesh really spans the forced device
+            # count — serial would train on ONE device at any count and
+            # the snapshot would never record the 8-device layout the
+            # guard must refuse
+            argv = [f"data={data}", "task=train", "objective=binary",
+                    "tree_learner=data", "num_leaves=31", "max_bin=63",
+                    "learning_rate=0.1", "min_data_in_leaf=20",
+                    "metric=none", "seed=17", "verbose=-1",
+                    f"output_model={model}",
+                    f"checkpoint_dir={ck}", "checkpoint_interval=2"] + extra
+            return subprocess.call(
+                [sys.executable, "-m", "lightgbm_tpu"] + argv,
+                env=env, cwd=work)
+
+        half = os.path.join(work, "shrink_half.txt")
+        if cli(["num_trees=10"], 8, half) != 0:
+            raise RuntimeError("8-device checkpointed run failed")
+        refused = cli(["num_trees=20", "resume_from=auto"], 4,
+                      os.path.join(work, "shrink_refused.txt"))
+        if refused == 0:
+            raise RuntimeError(
+                "resume at 4 devices WITHOUT tpu_reshard_on_resume "
+                "succeeded — the device-count guard is gone")
+        ck_oracle = os.path.join(work, "ck_shrink_oracle")
+        shutil.copytree(ck, ck_oracle)
+        elastic = ["num_trees=20", "resume_from=auto", "elastic=true",
+                   "tpu_reshard_on_resume=true"]
+        m1 = os.path.join(work, "shrink_elastic.txt")
+        m2 = os.path.join(work, "shrink_oracle.txt")
+        if cli(elastic, 4, m1) != 0:
+            raise RuntimeError("elastic 8->4 resume failed")
+        ck_saved, ck2 = ck_oracle, ck
+        shutil.rmtree(ck2)
+        shutil.copytree(ck_saved, ck2)
+        if cli(elastic, 4, m2) != 0:
+            raise RuntimeError("oracle 4-device resume failed")
+        identical = open(m1).read() == open(m2).read()
+        if not identical:
+            raise RuntimeError("elastic shrink is not bit-identical to a "
+                               "fresh 4-device resume of the same epoch")
+        return {"refused_rc_without_reshard": refused,
+                "identical_to_fresh_small_resume": identical}
+
+    try:
+        arm("lease_expiry", arm_lease)
+        arm("kv_flap_init", arm_kv_flap)
+        arm("manifest_mismatch", arm_manifest)
+        if fast:
+            out["arms"]["kill9_rank"] = {"ok": True, "skipped": "fast"}
+            out["arms"]["shrink_8to4"] = {"ok": True, "skipped": "fast"}
+            # keep the ledger fields comparable in FAST runs: the banked
+            # payload is only written by the full matrix (see below)
+        else:
+            arm("kill9_rank", arm_kill9)
+            arm("shrink_8to4", arm_shrink)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    out["value"] = sum(1 for a in out["arms"].values()
+                       if a.get("ok") and "skipped" not in a)
+    out["unit"] = "arms"
+    out["ok"] = ok
+    if err:
+        out["error"] = "; ".join(err)[:500]
+    print(json.dumps(out))
+    out_path = os.environ.get("LGBM_TPU_CHAOS_DIST_OUT", "")
+    if out_path and not fast:
+        from lightgbm_tpu.observability.export import atomic_write_json
+        atomic_write_json(out_path, out)
+    return 0 if ok else 1
+
+
 # --------------------------------------------------------------- multichip
 
 def _multichip_child_env(d, platform, cache_dir):
@@ -3068,6 +3486,26 @@ def run_compare(argv):
                                   "ok": not cp}
             problems = problems + cp
             break
+        # ... and the newest banked CHAOS_DIST result (bench.py
+        # --chaos-dist): the |chaos_dist= comparability key gates fleet
+        # MTTR, peer-loss detection latency, and shed-epoch regressions
+        # against distributed-chaos history only
+        for p in reversed(sorted(
+                _glob.glob(os.path.join(repo, "CHAOS_DIST_r*.json")))):
+            pl = perf_ledger.payload_of(p)
+            if not pl or pl.get("metric") != "chaos_dist":
+                continue
+            dp, dn = perf_ledger.compare(
+                pl, entries, exclude_source=os.path.basename(p))
+            out["chaos_dist"] = {"candidate": os.path.basename(p),
+                                 "value": pl.get("value"),
+                                 "fleet_mttr_s": pl.get("fleet_mttr_s"),
+                                 "detect_p99_ms": pl.get("detect_p99_ms"),
+                                 "shed_epochs": pl.get("shed_epochs"),
+                                 "problems": dp, "notes": dn,
+                                 "ok": not dp}
+            problems = problems + dp
+            break
     out["problems"] = problems
     out["ok"] = not problems
     print(json.dumps(out))
@@ -3087,6 +3525,8 @@ if __name__ == "__main__":
         sys.exit(run_serve_chaos(sys.argv))
     elif "--serve" in sys.argv:
         sys.exit(run_serve(sys.argv))
+    elif "--chaos-dist" in sys.argv:
+        sys.exit(run_chaos_dist(sys.argv))
     elif "--chaos" in sys.argv:
         sys.exit(run_chaos(sys.argv))
     elif "--compare" in sys.argv:

@@ -2,7 +2,7 @@
 
 Two halves (docs/Static-Analysis.md):
 
-- ``tpu_lint`` — an AST analyzer enforcing JAX/TPU hygiene rules R001-R012
+- ``tpu_lint`` — an AST analyzer enforcing JAX/TPU hygiene rules R001-R013
   (traced control flow, host syncs in hot paths, dtype-promotion hazards,
   Pallas tiling contracts, bad static_argnums, import-time jnp execution).
   CLI: ``python -m lightgbm_tpu.analysis lightgbm_tpu/``. Pure stdlib — it

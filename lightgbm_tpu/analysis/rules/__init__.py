@@ -12,6 +12,7 @@ from .device_transfer import DeviceTransferRule    # R009
 from .swallowed_exceptions import SwallowedExceptionRule  # R010
 from .serving_sync import ServingSyncRule          # R011
 from .thread_leak import ThreadLeakRule            # R012
+from .kv_isolation import KVIsolationRule          # R013
 
 _RULES = None
 
@@ -23,5 +24,5 @@ def active_rules():
                   PallasShapeRule(), StaticArgsRule(), ImportExecRule(),
                   SortInLoopRule(), AdHocTimingRule(), DeviceTransferRule(),
                   SwallowedExceptionRule(), ServingSyncRule(),
-                  ThreadLeakRule()]
+                  ThreadLeakRule(), KVIsolationRule()]
     return _RULES

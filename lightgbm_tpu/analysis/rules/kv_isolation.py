@@ -1,0 +1,90 @@
+"""R013: direct coordination-service KV calls outside the comm layer.
+
+The jax.distributed coordination-service client (``wait_at_barrier``,
+``blocking_key_value_get``, ``key_value_set_bytes``, ...) is the one
+shared channel every rank of a gang depends on — and every call site on
+it carries the full distributed-failure surface: timeouts that must be
+attributed to a rank, retries that must reset partial init, chaos
+injection that must see the traffic, and the R-isolation needed so the
+fault-tolerance tier (heartbeat leases, gang manifests, commit barriers)
+can reason about ALL KV traffic in one place.
+
+Scope: ``lightgbm_tpu/`` EXCEPT ``parallel/comm.py`` (the comm layer that
+owns the client, its retry policy, and the chaos ``_client_wrapper``
+indirection) and ``robustness/`` (the fault-tolerance protocols built on
+that layer — distributed.py's manifests/leases, chaos.py's fakes). A
+direct client call anywhere else bypasses retry_call's bounded backoff,
+the partial-init reset, AND the chaos wrapper — it works until the first
+KV flap, then hangs untyped. Route it through ``parallel.comm`` helpers
+(``host_allgather``, ``distributed_client`` + ``retry_call``) or the
+robustness protocols instead.
+
+Matched on attribute-call NAME (``anything.wait_at_barrier(...)``), so
+wrapped clients, ``self._client`` handles, and the raw
+``global_state.client`` are all caught without needing type inference.
+"""
+from __future__ import annotations
+
+import ast
+
+from .common import dotted_name
+
+RULE_ID = "R013"
+
+# the coordination-service client surface (jax._src.distributed client +
+# the *_bytes variants comm.py/distributed.py actually use)
+_KV_METHODS = {
+    "wait_at_barrier",
+    "blocking_key_value_get",
+    "blocking_key_value_get_bytes",
+    "key_value_set",
+    "key_value_set_bytes",
+    "key_value_delete",
+    "key_value_try_get",
+    "key_value_dir_get",
+    "key_value_dir_get_bytes",
+}
+
+_EXEMPT_MARKERS = (
+    "lightgbm_tpu/parallel/comm.py",
+    "lightgbm_tpu/robustness/",
+)
+
+
+def _in_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    if "lightgbm_tpu/" not in rel and not rel.startswith("lightgbm_tpu"):
+        return False
+    return not any(m in rel for m in _EXEMPT_MARKERS)
+
+
+class KVIsolationRule:
+    rule_id = RULE_ID
+    summary = ("direct coordination-service KV client call (wait_at_barrier/"
+               "blocking_key_value_get/...) outside parallel/comm.py and "
+               "robustness/ (bypasses retry, partial-init reset, and chaos "
+               "injection — route through parallel.comm / the robustness "
+               "protocols)")
+
+    def check(self, ctx):
+        if not _in_scope(ctx.rel):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            method = node.func.attr
+            if method not in _KV_METHODS:
+                continue
+            target = dotted_name(node.func) or f"<expr>.{method}"
+            yield ctx.finding(
+                self.rule_id, node,
+                f"`{target}(...)` talks to the coordination-service KV "
+                f"store directly — outside parallel/comm.py and "
+                f"robustness/ this bypasses retry_call's bounded backoff, "
+                f"the init partial-state reset, and chaos injection "
+                f"(ChaosKVClient), and hides gang traffic from the "
+                f"fault-tolerance tier. Use parallel.comm helpers "
+                f"(host_allgather, distributed_client + retry_call) or "
+                f"the robustness/distributed.py protocols.")

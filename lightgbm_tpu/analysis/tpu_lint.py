@@ -1,4 +1,4 @@
-"""tpu-lint: AST-based JAX/TPU hygiene analyzer (rules R001-R012).
+"""tpu-lint: AST-based JAX/TPU hygiene analyzer (rules R001-R013).
 
 The worst round-5 bugs were statically detectable: a 125-row Pallas
 accumulator block Mosaic rejects (sublane misalignment), u16 byte pairs
@@ -284,7 +284,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m lightgbm_tpu.analysis",
         description="tpu-lint: JAX/TPU hygiene analyzer — AST tier (rules "
-                    "R001-R012) and trace tier (--trace: jaxpr/HLO "
+                    "R001-R013) and trace tier (--trace: jaxpr/HLO "
                     "contracts T001-...)")
     ap.add_argument("paths", nargs="*", default=["lightgbm_tpu"],
                     help="files or directories to lint")

@@ -607,6 +607,22 @@ class Booster:
                 "feature_names": self.feature_names,
             },
         }
+        from .robustness import distributed as _dist
+        gang = _dist.gang_env()
+        if gang is not None:
+            # gang-consistent protocol: EVERY rank writes its shard, rank 0
+            # commits the epoch manifest behind the commit barrier
+            # (robustness/distributed.py; docs/Fault-Tolerance.md)
+            client, rank, world = gang
+            coord = _dist.GangCheckpointCoordinator(
+                directory, client=client, rank=rank, world=world,
+                keep_last_n=self.config.checkpoint_keep_last_n,
+                elastic=self.config.elastic)
+            path = coord.save(payload)
+            Log.info("gang checkpoint shard written: %s (rank %d/%d, "
+                     "iteration %d, %d trees)", path, rank, world,
+                     state["iter"], len(self.trees))
+            return path
         import jax
         if jax.process_count() > 1 and jax.process_index() != 0:
             return None

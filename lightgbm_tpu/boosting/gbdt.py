@@ -2138,6 +2138,23 @@ class GBDT:
                         "state written on %d device(s) onto %d (%s)",
                         int(saved_d), self.pctx.num_devices,
                         self.pctx.strategy)
+        saved_tl = state.get("tree_learner")
+        if saved_tl is not None and saved_tl != self.pctx.strategy \
+                and not reshard:
+            # as loud as the device-count guard above: a strategy swap at
+            # the SAME device count changes what the carried row state
+            # means (row-sharded vs replicated scores/masks) — never
+            # silently reinterpretable. Only an authorized reshard (device
+            # count changed + tpu_reshard_on_resume) may re-resolve the
+            # strategy, e.g. data -> serial when a gang shrinks to one
+            # device.
+            Log.fatal(
+                "checkpoint/learner mismatch: the snapshot was written "
+                "under tree_learner=%s but this booster runs %s on the "
+                "same device count — resume needs the same tree_learner "
+                "(a strategy change is only honored through an elastic "
+                "reshard: device count change + tpu_reshard_on_resume=true)",
+                saved_tl, self.pctx.strategy)
         shape_checks = [("num_data", self.num_data),
                         ("num_models", self.num_models)]
         if not reshard:

@@ -10,6 +10,7 @@ Usage:  python -m lightgbm_tpu config=train.conf [key=value ...]
 """
 from __future__ import annotations
 
+import os
 import sys
 from typing import Dict, List, Optional
 
@@ -186,6 +187,33 @@ def run_train(params: Dict) -> None:
                             "resume_from=auto self-heals)", e,
                             EXIT_SHARD_CORRUPT)
                 raise SystemExit(EXIT_SHARD_CORRUPT) from e
+            # comm loss — a peer rank died or stopped answering
+            # (PeerLostError names the rank; its base CommTimeoutError
+            # covers the generic collective deadline). Typed exit 145 so
+            # the fleet supervisor attributes the gang failure to a peer,
+            # not to this rank (docs/Fault-Tolerance.md exit-code table)
+            from .robustness.retry import CommTimeoutError, PeerLostError
+            if isinstance(e, CommTimeoutError):
+                from .robustness.watchdog import EXIT_COMM_LOST
+                Log.warning("comm loss: %s — exiting %d (%s; the fleet "
+                            "supervisor relaunches the gang from the "
+                            "newest consistent manifest)", e, EXIT_COMM_LOST,
+                            f"lost peer rank {e.rank}"
+                            if isinstance(e, PeerLostError)
+                            else "collective deadline expired")
+                import jax
+                if jax.process_count() > 1:
+                    # under a live gang sys.exit never reaches the shell:
+                    # jax's atexit shutdown blocks on its shutdown barrier
+                    # waiting for the DEAD peer and the coordination
+                    # service aborts the process (-6) — which the fleet
+                    # supervisor would misread as this rank being the
+                    # crash culprit
+                    import sys as _sys
+                    _sys.stdout.flush()
+                    _sys.stderr.flush()
+                    os._exit(EXIT_COMM_LOST)
+                raise SystemExit(EXIT_COMM_LOST) from e
             raise
     finally:
         if saved_handlers:

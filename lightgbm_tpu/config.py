@@ -487,6 +487,20 @@ class Config:
     # tpu_residency=stream (ops/stream.py); detected corruption raises
     # ShardCorruptionError (CLI exit 144) instead of training on rot
     tpu_stream_verify: bool = True
+    # --- distributed fault tolerance (robustness/distributed.py) ------------
+    # seconds between per-rank heartbeat-lease writes to the coordination-
+    # service KV store (beaten at the same dispatch boundaries the hang
+    # watchdog uses); also rate-limits the pre-wave liveness probe
+    gang_heartbeat_interval_s: float = 2.0
+    # a peer whose lease has not advanced for this long (by the OBSERVER's
+    # monotonic clock — cross-host clock skew is irrelevant) is declared
+    # lost: typed PeerLostError naming the rank, exit 145 at top level.
+    # 0 = peer failure detection off.
+    gang_lease_timeout_s: float = 30.0
+    # permit resume on a DIFFERENT world size than the gang checkpoint
+    # manifest records (the fleet supervisor's shrink path; pair with
+    # tpu_reshard_on_resume for the device re-layout). Off = loud refusal.
+    elastic: bool = False
 
     def __post_init__(self):
         self._check()
@@ -654,6 +668,19 @@ class Config:
                       "only), got %g", self.hang_median_factor)
         if self.hang_action not in ("dump", "abort"):
             Log.fatal("Unknown hang_action %s (dump|abort)", self.hang_action)
+        if self.gang_heartbeat_interval_s < 0:
+            Log.fatal("gang_heartbeat_interval_s must be >= 0, got %g",
+                      self.gang_heartbeat_interval_s)
+        if self.gang_lease_timeout_s < 0:
+            Log.fatal("gang_lease_timeout_s must be >= 0 (0 = peer failure "
+                      "detection off), got %g", self.gang_lease_timeout_s)
+        if 0 < self.gang_lease_timeout_s <= self.gang_heartbeat_interval_s:
+            # a lease shorter than the beat cadence declares every healthy
+            # peer dead between two writes
+            Log.fatal("gang_lease_timeout_s (%g) must exceed "
+                      "gang_heartbeat_interval_s (%g)",
+                      self.gang_lease_timeout_s,
+                      self.gang_heartbeat_interval_s)
         if self.tpu_profile_iters:
             from .observability.profiler import parse_profile_iters
             try:

@@ -291,41 +291,23 @@ def _find_bins(active: List[int], find_one,
     of ranks must not enter a collective here."""
     if config is None or getattr(config, "num_machines", 1) <= 1:
         return {j: find_one(j) for j in active}
-    from .parallel.comm import distributed_client
-    client = distributed_client()
+    from .parallel import comm
+    client = comm.distributed_client()
     import jax
     if client is None or jax.process_count() <= 1:
         return {j: find_one(j) for j in active}
 
-    import pickle
     rank, world = jax.process_index(), jax.process_count()
-    seq = _find_bins_seq[0]          # SPMD construct order is identical on
-    _find_bins_seq[0] += 1           # every process, so seq agrees
     timeout_ms = int(getattr(config, "time_out", 120)) * 60 * 1000
     mine = {j: find_one(j) for j in active if j % world == rank}
-    client.key_value_set_bytes(f"lgbm_binmappers/{seq}/{rank}",
-                               pickle.dumps(mine))
-    out: Dict[int, BinMapper] = dict(mine)
-    for r in range(world):
-        if r == rank:
-            continue
-        blob = client.blocking_key_value_get_bytes(
-            f"lgbm_binmappers/{seq}/{r}", timeout_ms)
-        out.update(pickle.loads(blob))
-    try:
-        # all ranks must have READ every shard before any key disappears
-        client.wait_at_barrier(f"lgbm_binmappers_done/{seq}", timeout_ms)
-        client.key_value_delete(f"lgbm_binmappers/{seq}/{rank}")
-    except Exception as e:                                   # noqa: BLE001
-        # best-effort server-side cleanup: the gather already succeeded,
-        # the key just lives until TTL — but the fault is LOGGED (R010),
-        # never silently eaten
-        Log.debug("binmapper KV cleanup failed (key left for TTL expiry): "
-                  "%s: %s", type(e).__name__, e)
+    # host_allgather owns the KV exchange end to end — per-peer retry with
+    # bounded backoff, typed PeerLostError attribution, chaos injection,
+    # done-barrier + key cleanup (R013: raw client calls stay in comm.py)
+    shards = comm.host_allgather(mine, "binmappers", timeout_ms=timeout_ms)
+    out: Dict[int, BinMapper] = {}
+    for shard in shards:
+        out.update(shard)
     return out
-
-
-_find_bins_seq = [0]
 
 
 def _csc_column(csc, j: int) -> Tuple[np.ndarray, np.ndarray]:

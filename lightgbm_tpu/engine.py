@@ -170,10 +170,29 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # newest snapshot that passes its integrity check, so a
             # truncated/bit-flipped latest costs one checkpoint interval
             # instead of killing the resume (docs/Fault-Tolerance.md)
+            from .robustness import distributed as _dist
             from .robustness.checkpoint import CheckpointManager
-            resolved = (CheckpointManager(
-                config.checkpoint_dir).latest_verified()
-                if config.checkpoint_dir else None)
+            resolved = None
+            if config.checkpoint_dir and _dist.list_manifests(
+                    config.checkpoint_dir):
+                # gang manifests present: the GANG protocol owns auto —
+                # every surviving rank resolves the same newest epoch ALL
+                # of them can verify (or falls back a full epoch together;
+                # robustness/distributed.py). A shrunk/solo restart over a
+                # gang directory still resolves through the manifests, just
+                # without the agreement round.
+                gang = _dist.gang_env()
+                client, rank, world = gang if gang is not None \
+                    else (None, 0, 1)
+                coord = _dist.GangCheckpointCoordinator(
+                    config.checkpoint_dir, client=client, rank=rank,
+                    world=world,
+                    keep_last_n=config.checkpoint_keep_last_n,
+                    elastic=config.elastic)
+                resolved = coord.resolve_resume()
+            elif config.checkpoint_dir:
+                resolved = CheckpointManager(
+                    config.checkpoint_dir).latest_verified()
             if resolved is None:
                 Log.info("resume_from=auto: no checkpoint under %r — "
                          "starting fresh", config.checkpoint_dir)
@@ -293,6 +312,26 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # wedged collective/transfer blocks the loop, the beats stop, and the
     # watchdog dumps diagnostics (hang_action=abort additionally exits 142
     # so the supervisor restarts from the last checkpoint).
+    # ---- peer heartbeat lease (robustness/distributed.py) ------------------
+    # under a live gang each rank beats a seq lease in the KV store at the
+    # same dispatch boundaries the watchdog beats at, and probes the peers'
+    # leases BEFORE entering each collective wave — a dead peer raises a
+    # typed PeerLostError naming the rank instead of wedging the collective
+    lease = None
+    if config.gang_lease_timeout_s > 0:
+        from .robustness import distributed as _dist
+        _gang = _dist.gang_env()
+        if _gang is not None:
+            _cl, _rk, _wd = _gang
+            lease = _dist.HeartbeatLease(
+                client=_cl, rank=_rk, world=_wd,
+                lease_timeout_s=config.gang_lease_timeout_s,
+                interval_s=config.gang_heartbeat_interval_s)
+            lease.beat(force=True)
+            Log.info("gang heartbeat lease armed: rank %d/%d, interval "
+                     "%.1fs, lease timeout %.1fs", _rk, _wd,
+                     config.gang_heartbeat_interval_s,
+                     config.gang_lease_timeout_s)
     watchdog = None
     if config.hang_timeout_s > 0:
         from .robustness.watchdog import HangWatchdog
@@ -300,7 +339,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
             timeout_s=config.hang_timeout_s,
             median_factor=config.hang_median_factor,
             action=config.hang_action,
-            dump_dir=(obs.telemetry_dir() or config.checkpoint_dir or "."))
+            dump_dir=(obs.telemetry_dir() or config.checkpoint_dir or "."),
+            attribution_fn=lease.attribution if lease is not None else None)
         watchdog.beat(start_iter)
         watchdog.start()
         Log.info("hang watchdog armed: timeout %.1fs, median factor %g, "
@@ -317,6 +357,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 profile_window.before_step(it, k)
                 for cb in callbacks_before:
                     cb(CallbackEnv(booster, params, it, 0, n_rounds, None))
+                if lease is not None:
+                    # beat FIRST, then probe: the lease must advance before
+                    # this rank disappears into a potentially long dispatch
+                    # (first-step compiles run minutes), so peer ages
+                    # measure inter-rank skew at the boundary — not
+                    # iteration time. Then the pre-wave liveness probe
+                    # detects a dead peer BEFORE dispatching the collective
+                    # (PeerLostError names the rank; both are rate-limited
+                    # inside, host-only, no device sync)
+                    lease.beat()
+                    lease.probe()
                 if fobj is not None:
                     gbdt.train_one_iter_custom(fobj)
                 else:
@@ -325,6 +376,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 profile_window.after_step(it_end)
                 if watchdog is not None:
                     watchdog.beat(it_end)
+                if lease is not None:
+                    lease.beat()
                 eval_results = []
                 if gbdt.valid_sets or gbdt.config.is_training_metric:
                     # eval when the batch crossed a metric_freq boundary
@@ -342,9 +395,24 @@ def train(params: Dict[str, Any], train_set: Dataset,
     except EarlyStopException as e:
         best_iteration = e.best_iteration + 1
         booster.best_score = e.best_score
+    except Exception as e:
+        # a peer that dies MID-wave (after the pre-wave probe) surfaces as
+        # a raw XlaRuntimeError from the dead collective (gloo TCP reset,
+        # coordination-service health poll) — map it onto the typed comm-
+        # loss errors, naming the rank from the heartbeat leases, so the
+        # CLI exits 145 and the fleet supervisor attributes the survivor
+        from .robustness.retry import CommRetryError
+        if lease is not None and not isinstance(e, CommRetryError):
+            from .robustness.distributed import comm_loss_error
+            typed = comm_loss_error(e, lease)
+            if typed is not None:
+                raise typed from e
+        raise
     finally:
         if watchdog is not None:
             watchdog.stop()
+        if lease is not None:
+            lease.withdraw()
         profile_window.close()
         # telemetry finalize + flush must never take the run down — and must
         # run on EVERY exit path (early stop, nan_policy=raise, comm errors)

@@ -55,6 +55,14 @@ DEFAULT_TOLERANCES = {
     # 0.05 absolute allowance — shedding much more at equal load means
     # serving capacity regressed even if measured rows/s held
     "shed": 0.5,
+    # chaos-dist recovery bands: fleet MTTR and peer-loss detection
+    # latency are wall-clock of process relaunch + jit compile on a shared
+    # CI box, so the bands are very wide (100% relative) — they exist to
+    # catch order-of-magnitude regressions (a lost heartbeat probe turning
+    # detection from ms into the full lease timeout; a resume path that
+    # silently retrains from scratch), not run-to-run noise
+    "mttr": 1.0,
+    "detect": 1.0,
 }
 
 
@@ -89,8 +97,10 @@ def normalize_bench(payload: Optional[Dict], source: str,
                "platform": None, "rows": None, "kernel": None,
                "n_devices": None, "residency": None, "tree_batch": None,
                "auc": None, "serve": None, "serve_chaos": None,
-               "bundle": None, "linear": None, "shed_rate": None,
-               "p99_ms": None,
+               "chaos_dist": None, "bundle": None, "linear": None,
+               "shed_rate": None, "p99_ms": None,
+               "fleet_mttr_s": None, "detect_p50_ms": None,
+               "detect_p99_ms": None, "shed_epochs": None,
                "recompiles_post_warmup": None, "host_syncs": None,
                "steady_s_per_iter": None, "hbm_peak_gb": None,
                "cost": None, "error": None}
@@ -99,8 +109,10 @@ def normalize_bench(payload: Optional[Dict], source: str,
         return e
     for k in ("value", "unit", "vs_baseline", "platform", "rows", "kernel",
               "n_devices", "residency", "tree_batch", "auc", "serve",
-              "serve_chaos", "bundle", "linear", "shed_rate",
-              "p99_ms", "recompiles_post_warmup", "hbm_peak_gb", "error"):
+              "serve_chaos", "chaos_dist", "bundle", "linear", "shed_rate",
+              "p99_ms", "fleet_mttr_s", "detect_p50_ms", "detect_p99_ms",
+              "shed_epochs", "recompiles_post_warmup", "hbm_peak_gb",
+              "error"):
         if payload.get(k) is not None:
             e[k] = payload[k]
     head = (payload.get("phase_timings") or {}).get("headline") or {}
@@ -159,6 +171,7 @@ def load_history(root: str) -> List[Dict]:
                       ("STREAM_r*.json", normalize_bench),
                       ("SERVE_r*.json", normalize_bench),
                       ("SERVE_CHAOS_r*.json", normalize_bench),
+                      ("CHAOS_DIST_r*.json", normalize_bench),
                       ("SPARSE_r*.json", normalize_bench),
                       ("LINEAR_r*.json", normalize_bench),
                       ("MULTICHIP_r*.json", normalize_multichip)):
@@ -192,7 +205,11 @@ def comparability_key(e: Dict) -> str:
     Serve-chaos results (``bench.py --serve-chaos``) key on their
     fault-injection shape (``serve_chaos="open|b4|overload"``): numbers
     measured UNDER injected overload and faults are a comparability class
-    of their own. Sparse-bench results (``bench.py --sparse``,
+    of their own. Distributed-chaos results (``bench.py --chaos-dist``,
+    CHAOS_DIST_r*.json) key the same way on their gang/fault matrix shape
+    (``chaos_dist="gang2|kill9+flap+lease+manifest+shrink"``): fleet MTTR
+    and detection latency only compare against runs of the SAME chaos
+    matrix. Sparse-bench results (``bench.py --sparse``,
     SPARSE_r*.json) additionally key on the EFB representation
     (``bundle="bundlespace"``): the bundle-space, legacy-unpack, and
     no-EFB arms deliberately trade throughput against memory layout, so a
@@ -205,7 +222,8 @@ def comparability_key(e: Dict) -> str:
     return (f"platform={e.get('platform')}|rows={e.get('rows')}"
             f"|kernel={e.get('kernel')}|n_devices={e.get('n_devices')}"
             f"|residency={e.get('residency')}|serve={e.get('serve')}"
-            f"|serve_chaos={e.get('serve_chaos')}|bundle={e.get('bundle')}"
+            f"|serve_chaos={e.get('serve_chaos')}"
+            f"|chaos_dist={e.get('chaos_dist')}|bundle={e.get('bundle')}"
             f"|linear={e.get('linear')}")
 
 
@@ -257,7 +275,8 @@ def best_known(entries: List[Dict],
                  and e.get("source") != exclude_source
                  and comparability_key(e) == key]
         for field in ("recompiles_post_warmup", "host_syncs", "hbm_peak_gb",
-                      "p99_ms", "shed_rate"):
+                      "p99_ms", "shed_rate", "fleet_mttr_s",
+                      "detect_p50_ms", "detect_p99_ms", "shed_epochs"):
             vals = [e[field] for e in group if e.get(field) is not None]
             slot[f"min_{field}"] = min(vals) if vals else None
     return best
@@ -274,7 +293,11 @@ def build_ledger(root: str) -> Dict:
                 "min_host_syncs": v.get("min_host_syncs"),
                 "min_hbm_peak_gb": v.get("min_hbm_peak_gb"),
                 "min_p99_ms": v.get("min_p99_ms"),
-                "min_shed_rate": v.get("min_shed_rate")}
+                "min_shed_rate": v.get("min_shed_rate"),
+                "min_fleet_mttr_s": v.get("min_fleet_mttr_s"),
+                "min_detect_p50_ms": v.get("min_detect_p50_ms"),
+                "min_detect_p99_ms": v.get("min_detect_p99_ms"),
+                "min_shed_epochs": v.get("min_shed_epochs")}
             for k, v in sorted(best_known(entries).items())}
     best_mc = {k: {"source": v["source"], "round": v["round"],
                    "value": v["value"],
@@ -382,6 +405,34 @@ def compare(candidate: Dict, entries: List[Dict],
                 f"shed vs best-known {min_shed} — shedding more at the "
                 f"same offered overload means serving capacity regressed "
                 f"(+{tol['shed']:.0%} relative +0.05 absolute band)")
+        # chaos-dist recovery gates (bench.py --chaos-dist): wide relative
+        # bands plus small absolute allowances, because both numbers ride
+        # process relaunch + jit compile wall-clock on a shared box
+        min_mttr = slot.get("min_fleet_mttr_s")
+        if (min_mttr is not None and c.get("fleet_mttr_s") is not None
+                and c["fleet_mttr_s"] > min_mttr * (1.0 + tol["mttr"]) + 5.0):
+            problems.append(
+                f"fleet-MTTR regression: {c['fleet_mttr_s']} s from gang "
+                f"failure to a newer recovery point vs best-known "
+                f"{min_mttr} s (+{tol['mttr']:.0%} relative +5s absolute "
+                f"band)")
+        min_det = slot.get("min_detect_p99_ms")
+        if (min_det is not None and c.get("detect_p99_ms") is not None
+                and c["detect_p99_ms"]
+                > min_det * (1.0 + tol["detect"]) + 200.0):
+            problems.append(
+                f"peer-loss detection regression: p99 {c['detect_p99_ms']} "
+                f"ms to a typed PeerLostError vs best-known {min_det} ms "
+                f"(+{tol['detect']:.0%} relative +200ms absolute band)")
+        min_se = slot.get("min_shed_epochs")
+        if (min_se is not None and c.get("shed_epochs") is not None
+                and c["shed_epochs"] > min_se + 1):
+            problems.append(
+                f"shed-epochs regression: the gang fell back "
+                f"{c['shed_epochs']} epoch(s) to agree on a resume point "
+                f"vs best-known {min_se} (+1 allowance) — losing more "
+                f"banked epochs under the same chaos matrix means the "
+                f"manifest commit protocol regressed")
         problems.extend(_cost_drift(c, b, tol["cost"]))
     return problems, notes
 

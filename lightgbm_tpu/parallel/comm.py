@@ -36,6 +36,7 @@ argmax below), so every shard re-partitions consistently.
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Callable, NamedTuple, Tuple
 
@@ -900,7 +901,33 @@ def _local_rank(machines, local_listen_port: int) -> int:
         f"{local_listen_port}")
 
 
-_host_allgather_seq = [0]
+class _PerThreadSeq:
+    """The host_allgather sequence counter, kept PER-THREAD. A real gang has
+    one process per rank, so plain module state advances in SPMD lockstep;
+    the in-process gang simulations (robustness/chaos.py, bench --chaos-dist:
+    one thread per simulated rank over a FakeKVStore) need the same
+    per-rank isolation or concurrent ranks steal each other's sequence
+    numbers and the exchange keys never meet. Indexable like the plain list
+    it replaced (tests read ``_host_allgather_seq[0]``)."""
+
+    def __init__(self):
+        import threading
+        self._local = threading.local()
+
+    def _lst(self):
+        lst = getattr(self._local, "lst", None)
+        if lst is None:
+            lst = self._local.lst = [0]
+        return lst
+
+    def __getitem__(self, i):
+        return self._lst()[i]
+
+    def __setitem__(self, i, value):
+        self._lst()[i] = value
+
+
+_host_allgather_seq = _PerThreadSeq()
 
 # chaos-injection hook (robustness/chaos.py): when set, every KV client
 # host_allgather obtains is wrapped before use — fault paths become
@@ -933,9 +960,9 @@ def host_allgather(obj, tag: str, timeout_ms: int = 600_000, *,
     to the live jax.distributed state.
     """
     import pickle
+    import time as _time
 
-    from ..robustness.retry import (CommTimeoutError, comm_attempts,
-                                    retry_call)
+    from ..robustness.retry import (PeerLostError, comm_attempts, retry_call)
     from ..utils.log import Log
 
     if client is None:
@@ -972,6 +999,7 @@ def host_allgather(obj, tag: str, timeout_ms: int = 600_000, *,
         # attempts x timeout_ms (retrying only pays off for the
         # transient-error/corrupt-payload cases anyway)
         per_attempt_ms = max(1, timeout_ms // comm_attempts())
+        slowest_rank, slowest_wait = rank, -1.0
         for r in range(world):
             if r == rank:
                 out.append(obj)
@@ -984,17 +1012,29 @@ def host_allgather(obj, tag: str, timeout_ms: int = 600_000, *,
                                                           per_attempt_ms)
                 return pickle.loads(raw)
 
+            t0 = _time.monotonic()
             try:
                 out.append(retry_call(
                     _get, what=f"host_allgather get tag={tag!r} seq={seq} "
                                f"rank={rank}<-{r}"))
             except Exception as e:
+                # the per-wave deadline expired on THIS peer: attribute the
+                # loss to the rank, not a generic hang — fleet restart
+                # policy keys off the typed error and the metrics
                 _obs.inc("comm.timeouts")
-                raise CommTimeoutError(
+                _obs.inc("fault.peer_lost")
+                _obs.get_registry().gauge("comm.slowest_rank").set(r)
+                raise PeerLostError(
                     f"host_allgather tag={tag!r} seq={seq}: rank {rank} "
                     f"could not fetch rank {r}'s shard within "
-                    f"~{timeout_ms} ms total over "
-                    f"{e.__class__.__name__}: {e}") from e
+                    f"~{timeout_ms} ms total — peer rank {r} is the "
+                    f"missing/slowest rank in this wave "
+                    f"({e.__class__.__name__}: {e})", rank=r) from e
+            waited = _time.monotonic() - t0
+            if waited > slowest_wait:
+                slowest_rank, slowest_wait = r, waited
+        if world > 1 and slowest_wait >= 0.0:
+            _obs.get_registry().gauge("comm.slowest_rank").set(slowest_rank)
         # every rank must have READ every shard before any key disappears
         barrier_ok = False
         try:
@@ -1016,11 +1056,60 @@ def host_allgather(obj, tag: str, timeout_ms: int = 600_000, *,
         return out
 
 
+class _SafeKVClient:
+    """Bytes-safe facade over jax's DistributedRuntimeClient KV surface.
+
+    The ``*_bytes`` getters on the bundled jaxlib CPU wheels segfault when
+    fetching a key written by ANOTHER process (the py::bytes return path;
+    reproduced with a bare two-process ``jax.distributed`` cluster on
+    jaxlib 0.4.36 — the string getter on the same key is fine), so every
+    byte payload rides the string API base64-encoded instead. The facade
+    keeps the ``*_bytes`` call surface the rest of the package (and the
+    FakeKVStore / ChaosKVClient doubles) speaks; anything else delegates
+    to the real client untouched.
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def key_value_set_bytes(self, key: str, value: bytes,
+                            allow_overwrite: bool = False) -> None:
+        import base64
+        self._inner.key_value_set(key,
+                                  base64.b64encode(value).decode("ascii"),
+                                  allow_overwrite=allow_overwrite)
+
+    def blocking_key_value_get_bytes(self, key: str,
+                                     timeout_ms: int) -> bytes:
+        import base64
+        return base64.b64decode(
+            self._inner.blocking_key_value_get(key, timeout_ms))
+
+    def wait_at_barrier(self, key: str, timeout_ms: int):
+        return self._inner.wait_at_barrier(key, timeout_ms)
+
+    def key_value_delete(self, key: str):
+        return self._inner.key_value_delete(key)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+_safe_kv_client = None
+
+
 def distributed_client():
-    """The jax coordination-service client, or None when not running under
-    jax.distributed (single probe point for the private-API access)."""
+    """The jax coordination-service client wrapped in the bytes-safe KV
+    facade, or None when not running under jax.distributed (single probe
+    point for the private-API access)."""
+    global _safe_kv_client
     from jax._src import distributed as _dist
-    return _dist.global_state.client
+    raw = _dist.global_state.client
+    if raw is None:
+        return None
+    if _safe_kv_client is None or _safe_kv_client._inner is not raw:
+        _safe_kv_client = _SafeKVClient(raw)
+    return _safe_kv_client
 
 
 def init_distributed(config) -> bool:
@@ -1095,6 +1184,26 @@ def init_distributed(config) -> bool:
             f"coordination service at {coord} "
             f"(world size {len(machines)}, timeout {config.time_out} min): "
             f"{type(e).__name__}: {e}") from e
+    # the CPU backend runs multiprocess computations only through its gloo
+    # collectives; without this a 2-process CPU gang dies in the FIRST
+    # fused step with "Multiprocess computations aren't implemented on the
+    # CPU backend". Selected only once the handshake landed a live
+    # distributed client (gloo's TCP store rides it; selecting gloo with
+    # no client poisons every later backend init) and before the
+    # process_count() below instantiates the backend — Network::Init
+    # ordering (init_distributed before any device work) matters here too.
+    # TPU/GPU read their collectives from the platform.
+    if "cpu" in (os.environ.get("JAX_PLATFORMS") or "").lower():
+        from jax._src import distributed as _dist
+        if _dist.global_state.client is not None:
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception as e:                           # noqa: BLE001
+                from ..utils.log import Log
+                Log.warning("could not select gloo CPU collectives "
+                            "(%s: %s) — multiprocess CPU computations may "
+                            "be unavailable", type(e).__name__, e)
     return jax.process_count() > 1
 
 

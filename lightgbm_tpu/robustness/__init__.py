@@ -14,10 +14,18 @@ arXiv:2005.09148). The modules here close the self-healing loop
                     lightgbm_tpu.robustness.checkpoint --verify DIR``.
 - ``supervisor``  — relaunch a killed/wedged CLI train child with
                     ``resume_from=auto`` under bounded restarts + backoff,
-                    recording restarts and measured recovery time (MTTR).
+                    recording restarts and measured recovery time (MTTR);
+                    ``--fleet=N`` supervises a whole multi-process gang
+                    with per-rank failure attribution and elastic shrink.
 - ``watchdog``    — heartbeat-fed hang/straggler detection at dispatch
                     boundaries; dumps thread stacks + the observability
-                    snapshot, optionally aborts-to-checkpoint (exit 142).
+                    snapshot, optionally aborts-to-checkpoint (exit 142,
+                    or 145 when the lease attribution names a lost peer).
+- ``distributed`` — gang-consistent checkpoint manifests (every rank's
+                    shard + rank-0 epoch manifest behind a commit
+                    barrier), per-rank heartbeat leases with typed
+                    ``PeerLostError`` peer-death detection, and the
+                    agreed-epoch elastic resume protocol.
 - ``retry``       — bounded retry with exponential backoff + jitter for the
                     coordination-service KV ops (parallel/comm.py).
 - ``numeric``     — non-finite gradient/hessian/leaf detection and the
@@ -52,16 +60,21 @@ def allowed_host_sync(reason: str):
 
 from .checkpoint import (CheckpointError, CheckpointManager,  # noqa: E402
                          config_fingerprint, verify_checkpoint)
-from .retry import CommRetryError, CommTimeoutError, retry_call  # noqa: E402
-from .supervisor import Supervisor  # noqa: E402
-from .watchdog import EXIT_HANG, HangWatchdog  # noqa: E402
+from .distributed import (GangCheckpointCoordinator,  # noqa: E402
+                          HeartbeatLease)
+from .retry import (CommRetryError, CommTimeoutError,  # noqa: E402
+                    PeerLostError, retry_call)
+from .supervisor import FleetSupervisor, Supervisor  # noqa: E402
+from .watchdog import EXIT_COMM_LOST, EXIT_HANG, HangWatchdog  # noqa: E402
 
 __all__ = [
     "allowed_host_sync",
     "CheckpointError", "CheckpointManager", "config_fingerprint",
     "verify_checkpoint",
-    "CommRetryError", "CommTimeoutError", "retry_call",
-    "Supervisor", "HangWatchdog", "EXIT_HANG",
+    "GangCheckpointCoordinator", "HeartbeatLease",
+    "CommRetryError", "CommTimeoutError", "PeerLostError", "retry_call",
+    "Supervisor", "FleetSupervisor", "HangWatchdog",
+    "EXIT_HANG", "EXIT_COMM_LOST",
     "NonFiniteError", "ShardCorruptionError",
 ]
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import os
 import random
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
@@ -142,15 +143,26 @@ class FakeKVStore:
     or the (real-time) timeout expires, raising ``TimeoutError`` like the
     real client. ``barrier_fails=True`` simulates a peer that never reaches
     the cleanup barrier.
+
+    ``world=N`` (N > 1) makes ``wait_at_barrier`` a REAL counting barrier:
+    the call blocks until N callers arrive at the same barrier key (or the
+    timeout expires) — required when one FakeKVStore backs a multi-THREADED
+    gang simulation (bench --chaos-dist), where returning immediately would
+    let one rank delete its exchange keys before a peer has read them. The
+    default (None) keeps the historical record-and-return behavior the
+    single-threaded tests script against.
     """
 
     def __init__(self, entries=None, barrier_fails: bool = False,
-                 poll_interval: float = 0.001):
+                 poll_interval: float = 0.001, world: Optional[int] = None):
         self.data = dict(entries or {})
         self.barrier_fails = barrier_fails
         self.poll_interval = poll_interval
+        self.world = world
         self.barrier_waits: List[str] = []
         self.deleted: List[str] = []
+        self._barrier_lock = threading.Lock()
+        self._barrier_counts: dict = {}
 
     def preload(self, key: str, value: bytes) -> "FakeKVStore":
         self.data[key] = value
@@ -174,10 +186,31 @@ class FakeKVStore:
             time.sleep(self.poll_interval)
 
     def wait_at_barrier(self, key: str, timeout_ms: int) -> None:
-        self.barrier_waits.append(key)
+        with self._barrier_lock:
+            self.barrier_waits.append(key)
         if self.barrier_fails:
             raise TimeoutError(
                 f"FakeKVStore: barrier {key!r} timed out after {timeout_ms} ms")
+        if not self.world or self.world <= 1:
+            return
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._barrier_lock:
+            n = self._barrier_counts[key] = \
+                self._barrier_counts.get(key, 0) + 1
+        # cycle-aware: simulated ranks re-enter the same barrier key across
+        # checkpoint epochs (per-thread allgather sequences restart with
+        # each simulated-rank thread), so the i-th wave of `world` arrivals
+        # forms its own barrier instead of sailing through on stale counts
+        target = ((n + self.world - 1) // self.world) * self.world
+        while True:
+            with self._barrier_lock:
+                if self._barrier_counts.get(key, 0) >= target:
+                    return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"FakeKVStore: barrier {key!r} timed out after "
+                    f"{timeout_ms} ms waiting for {self.world} participants")
+            time.sleep(self.poll_interval)
 
     def key_value_delete(self, key: str) -> None:
         self.deleted.append(key)
@@ -247,6 +280,33 @@ def kill_after_checkpoints(proc, ckpt_dir: str, n: int = 2,
             time.sleep(poll_s)
 
     t = threading.Thread(target=_killer, name="lgbm-chaos-killer",
+                         daemon=True)
+    t.start()
+    return t
+
+
+def kill_after_manifests(proc, ckpt_dir: str, n: int = 2,
+                         timeout_s: float = 300.0, poll_s: float = 0.05):
+    """Manifest-aware sibling of :func:`kill_after_checkpoints` for GANG
+    runs: SIGKILLs ``proc`` once ``ckpt_dir`` holds at least ``n``
+    committed epoch manifests (robustness/distributed.py) — 'one rank dies
+    mid-epoch after the gang has banked consistent state', the kill arm of
+    ``bench.py --chaos-dist``. Returns the started daemon thread."""
+    import threading
+
+    from .distributed import list_manifests
+
+    def _killer():
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and proc.poll() is None:
+            if len(list_manifests(ckpt_dir)) >= n:
+                Log.debug("chaos: SIGKILLing pid %s at %d gang manifests",
+                          getattr(proc, "pid", "?"), n)
+                proc.kill()
+                return
+            time.sleep(poll_s)
+
+    t = threading.Thread(target=_killer, name="lgbm-chaos-gang-killer",
                          daemon=True)
     t.start()
     return t

@@ -98,6 +98,12 @@ VOLATILE_CONFIG_FIELDS = frozenset({
     # a watchdog-aborted run resumes under any watchdog/verify settings
     "hang_timeout_s", "hang_median_factor", "hang_action",
     "tpu_stream_verify",
+    # distributed fault tolerance (robustness/distributed.py): heartbeat
+    # cadence, lease deadlines, and the elastic-resume permission are
+    # detection/recovery policy — a gang snapshot resumes under any of
+    # them (elastic in particular MUST be settable on the restart that
+    # shrinks the fleet)
+    "gang_heartbeat_interval_s", "gang_lease_timeout_s", "elastic",
     # cluster wiring: the restarted pod gets fresh addresses/ports
     "machines", "machine_list_file", "local_listen_port", "time_out",
     # profiling/telemetry (observability/: spans, exporters, profiler window)
@@ -406,8 +412,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     audit every snapshot's integrity from the shell (jax-free — safe to run
     against a live training run's checkpoint directory).
 
-    Exit codes: 0 = every snapshot verifies; 1 = corrupt snapshot(s)
-    present but a verified resume target exists (named on stdout);
+    A directory holding gang epoch manifests (``manifest_*.json`` from
+    ``robustness/distributed.py``) is audited at the MANIFEST level too:
+    every listed shard must be present with the crc32 the manifest records.
+    A manifest whose shard set disagrees is CORRUPT, and when no manifest
+    verifies the gang has nothing consistent to resume from — exit 2 even
+    if stray snapshot files happen to parse (a shard without its committed
+    manifest is exactly the mixed-iteration resume the protocol forbids).
+
+    Exit codes: 0 = every snapshot (and manifest) verifies; 1 = corrupt
+    item(s) present but a verified resume target exists (named on stdout);
     2 = no usable snapshot (none found, or all corrupt)."""
     import argparse
     import sys
@@ -420,14 +434,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     target = args.verify
+    manifests = []
     if os.path.isfile(target):
         entries = [(None, target)]
     else:
+        # gang manifests are audited lazily so the CLI stays jax-free and
+        # single-process directories pay nothing for the import
+        from .distributed import audit_manifest_dir
+        manifests = audit_manifest_dir(target) if os.path.isdir(target) else []
         entries = CheckpointManager(target).list_checkpoints() \
             if os.path.isdir(target) else []
-        if not entries:
-            print(f"no checkpoints (ckpt_*.pkl) found under {target}",
-                  file=sys.stderr)
+        if not entries and not manifests:
+            print(f"no checkpoints (ckpt_*.pkl) or gang manifests "
+                  f"(manifest_*.json) found under {target}", file=sys.stderr)
             return 2
     newest_ok, n_bad = None, 0
     for _ckpt_id, path in entries:
@@ -438,8 +457,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             newest_ok = path
         else:
             n_bad += 1
+    if manifests:
+        # gang semantics override loose files: the resume target is the
+        # newest manifest whose WHOLE shard set verifies
+        newest_ok = None
+        for _epoch, path, ok, detail in manifests:
+            print(f"{os.path.basename(path):<24} "
+                  f"{'OK     ' if ok else 'CORRUPT'}  {detail}")
+            if ok:
+                newest_ok = path
+            else:
+                n_bad += 1
     if newest_ok is None:
-        print("no verified snapshot — nothing to resume from",
+        print("no verified %s — nothing to resume from"
+              % ("gang manifest" if manifests else "snapshot"),
               file=sys.stderr)
         return 2
     print(f"resume target: {newest_ok}")

@@ -1,0 +1,710 @@
+"""Distributed fault tolerance: gang-consistent checkpoints, heartbeat
+leases, peer-loss detection, and elastic resume (docs/Fault-Tolerance.md
+"Distributed fault tolerance").
+
+The single-process self-healing loop (checkpoint -> supervisor -> verify)
+assumes one writer and one reader. Under ``num_machines>1`` that breaks in
+three ways this module exists to close:
+
+1. **Torn checkpoints.** Rank-0-only snapshots capture rank 0's view; a
+   preemption between ranks' dispatch boundaries can leave per-process
+   state disagreeing on the iteration. Gang-consistent checkpointing makes
+   the epoch atomic: every rank writes its own shard snapshot, the per-rank
+   CRCs are exchanged host-side, and rank 0 commits an **epoch manifest**
+   (iteration, n_devices, per-rank CRCs) through the coordination-service
+   KV store behind a commit barrier. An epoch either has a manifest every
+   rank persisted — or it does not exist.
+
+2. **Mixed-iteration resume.** ``resume_from=auto`` resolves the newest
+   manifest that ALL surviving ranks can verify locally (manifest present,
+   own shard present, CRC matches): the per-rank verified-epoch sets are
+   allgathered and intersected, so a rank missing its shard drags the whole
+   gang back one epoch **together** — never a resume where rank 0 is at
+   iteration 40 and rank 1 at 38.
+
+3. **Generic hangs instead of named failures.** Each rank beats a
+   **heartbeat lease** in the KV store at the same dispatch boundaries the
+   hang watchdog uses (a monotonically increasing sequence number — peers
+   judge staleness by *their own* clock, so cross-host clock skew never
+   fakes a death). A pre-wave probe detects a peer whose lease expired
+   BEFORE entering the collective and raises a typed :class:`PeerLostError`
+   naming the rank; for a peer that dies mid-wave, the watchdog's
+   attribution hook probes the same leases at firing time, names the
+   slowest/missing rank in the dump and log, and aborts with exit 145
+   (comm loss) instead of the generic 142.
+
+The protocol is **host-side only** — KV sets/gets at dispatch boundaries,
+never a device sync or a new jit program — so ``bench.py --smoke`` stays
+0-recompile / 0-host-sync with heartbeats and manifest commits enabled.
+
+Manifest protocol (one ``save()``)::
+
+    rank 0                     rank 1..W-1
+    write shard_E_r0000.pkl    write shard_E_rNNNN.pkl
+        \\_____ allgather (rank, file, crc, size, iteration) _____/
+    build manifest JSON
+    KV set manifest/E  ------> KV get manifest/E
+    persist manifest_E.json    persist manifest_E.json
+        \\______________ commit barrier E ________________________/
+                    (epoch E now exists, everywhere)
+
+Elastic resume: a manifest records the world size it was written under.
+Resuming under a different world size is refused loudly unless
+``elastic=true`` — the sanctioned path for a fleet supervisor restarting
+on the surviving device count via ``tpu_reshard_on_resume``
+(robustness/supervisor.py ``--fleet``).
+
+Everything takes explicit ``client``/``rank``/``world`` so the chaos
+harness (robustness/chaos.py FakeKVStore / ChaosKVClient) drives the full
+protocol in-process; ``gang_env()`` resolves the live jax.distributed
+state (or a test override) for the engine/booster call sites.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import re
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.log import Log
+from .checkpoint import (ENVELOPE_MAGIC, _ENVELOPE, CheckpointError,
+                         FORMAT_VERSION, _fsync_dir, verify_checkpoint)
+from .retry import PeerLostError, retry_call
+
+MANIFEST_VERSION = 1
+
+_SHARD_RE = re.compile(r"^shard_(\d{10})_r(\d{4})\.pkl$")
+_MANIFEST_RE = re.compile(r"^manifest_(\d{10})\.json$")
+
+_KV_PREFIX = "lgbm_gang"
+
+
+# --------------------------------------------------------------- gang wiring
+
+# test/bench override: (client, rank, world) — lets the smoke run and the
+# in-process chaos arms drive the gang protocol over a FakeKVStore without
+# a real multi-process cluster
+_gang_override: Optional[Tuple[object, int, int]] = None
+
+
+def install_gang_override(client, rank: int = 0, world: int = 1) -> None:
+    """Force :func:`gang_env` to report a gang backed by ``client`` (a
+    FakeKVStore or any coordination-service-shaped object). Undo with
+    :func:`uninstall_gang_override`."""
+    global _gang_override
+    _gang_override = (client, int(rank), int(world))
+
+
+def uninstall_gang_override() -> None:
+    global _gang_override
+    _gang_override = None
+
+
+def gang_env() -> Optional[Tuple[object, int, int]]:
+    """``(kv_client, rank, world)`` when the gang-consistent protocol should
+    engage — a live multi-process ``jax.distributed`` run, or an installed
+    test override — else None (plain single-process semantics). The client
+    is routed through ``parallel.comm._client_wrapper`` so KV chaos
+    injection covers the gang protocol exactly like ``host_allgather``."""
+    from ..parallel import comm
+    if _gang_override is not None:
+        client, rank, world = _gang_override
+        if comm._client_wrapper is not None:
+            client = comm._client_wrapper(client)
+        return client, rank, world
+    import jax
+    if jax.process_count() <= 1:
+        return None
+    client = comm.distributed_client()
+    if client is None:
+        return None
+    if comm._client_wrapper is not None:
+        client = comm._client_wrapper(client)
+    return client, jax.process_index(), jax.process_count()
+
+
+# ----------------------------------------------------------- shard envelopes
+
+def write_shard_file(path: str, payload: Dict) -> Tuple[int, int]:
+    """Atomically write one per-rank shard snapshot with the standard
+    checkpoint integrity envelope (magic | crc32 | length | pickle).
+    Returns ``(crc32, size)`` of the payload bytes — the values the epoch
+    manifest records."""
+    raw = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    crc = zlib.crc32(raw) & 0xFFFFFFFF
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(_ENVELOPE.pack(ENVELOPE_MAGIC, crc, len(raw)))
+            fh.write(raw)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(path) or ".")
+    except OSError as e:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise CheckpointError(f"cannot write gang shard {path}: {e}") from e
+    return crc, len(raw)
+
+
+def envelope_crc(path: str) -> Optional[int]:
+    """The crc32 recorded in a snapshot file's envelope header (None for a
+    missing/short/legacy file) — compared against the manifest's record."""
+    try:
+        with open(path, "rb") as fh:
+            head = fh.read(_ENVELOPE.size)
+    except OSError:
+        return None
+    if len(head) < _ENVELOPE.size or not head.startswith(ENVELOPE_MAGIC):
+        return None
+    _magic, crc, _length = _ENVELOPE.unpack(head)
+    return crc
+
+
+def _write_bytes_atomic(path: str, raw: bytes, discriminator: str = "") -> None:
+    # the discriminator keeps concurrent writers of the same target apart
+    # (gang ranks sharing one directory — and one PID, in threaded sims —
+    # each persist the identical manifest bytes; last rename wins, benignly)
+    tmp = f"{path}.tmp.{os.getpid()}{discriminator}"
+    with open(tmp, "wb") as fh:
+        fh.write(raw)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+# ------------------------------------------------------------ manifest audit
+# Pure file+JSON+CRC checks — jax-free and comm-free, so the
+# ``checkpoint.py --verify`` CLI audits gang directories from the shell.
+
+def list_manifests(directory: str) -> List[Tuple[int, str]]:
+    """``[(epoch, manifest_path)]`` ascending; empty for a non-gang dir."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = _MANIFEST_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort()
+    return out
+
+
+def load_manifest(path: str) -> Dict:
+    """Parse + schema-check one epoch manifest; raises CheckpointError."""
+    try:
+        with open(path, "rb") as fh:
+            manifest = json.loads(fh.read().decode("utf-8"))
+    except (OSError, ValueError, UnicodeDecodeError) as e:
+        raise CheckpointError(
+            f"cannot parse gang manifest {path}: {type(e).__name__}: {e}") \
+            from e
+    if not isinstance(manifest, dict) or "shards" not in manifest:
+        raise CheckpointError(f"{path} is not a gang manifest (no shards)")
+    if manifest.get("manifest_version") != MANIFEST_VERSION:
+        raise CheckpointError(
+            f"{path} has manifest_version="
+            f"{manifest.get('manifest_version')}; this build reads version "
+            f"{MANIFEST_VERSION}")
+    return manifest
+
+
+def verify_manifest(path: str, directory: Optional[str] = None,
+                    only_rank: Optional[int] = None) -> Tuple[bool, str]:
+    """Check one manifest against the shard files on disk: every listed
+    shard (or just ``only_rank``'s) must exist, carry the recorded crc32 in
+    its envelope, and pass the full snapshot verification. Returns
+    ``(ok, detail)`` — never raises, so directory audits report every
+    manifest's state."""
+    directory = directory or os.path.dirname(path) or "."
+    try:
+        manifest = load_manifest(path)
+    except CheckpointError as e:
+        return False, str(e)
+    problems = []
+    checked = 0
+    for shard in manifest.get("shards", []):
+        rank = shard.get("rank")
+        if only_rank is not None and rank != only_rank:
+            continue
+        checked += 1
+        spath = os.path.join(directory, shard.get("file", ""))
+        if not os.path.isfile(spath):
+            problems.append(f"rank {rank} shard {shard.get('file')} missing")
+            continue
+        crc = envelope_crc(spath)
+        if crc != shard.get("crc32"):
+            problems.append(
+                f"rank {rank} shard {shard.get('file')} crc32 "
+                f"{'<none>' if crc is None else f'{crc:#010x}'} != manifest "
+                f"{shard.get('crc32', 0):#010x}")
+            continue
+        ok, det = verify_checkpoint(spath)
+        if not ok:
+            problems.append(f"rank {rank} shard {shard.get('file')}: {det}")
+    if only_rank is not None and checked == 0:
+        problems.append(f"manifest lists no shard for rank {only_rank}")
+    if problems:
+        return False, "; ".join(problems)
+    return True, (f"epoch {manifest.get('epoch')}, iteration "
+                  f"{manifest.get('iteration')}, world "
+                  f"{manifest.get('world')}, {checked} shard(s) verified")
+
+
+def audit_manifest_dir(directory: str) -> List[Tuple[int, str, bool, str]]:
+    """``[(epoch, manifest_path, ok, detail)]`` ascending by epoch — the
+    directory-level audit behind ``checkpoint.py --verify`` on a gang
+    checkpoint directory."""
+    return [(epoch, path, *verify_manifest(path, directory))
+            for epoch, path in list_manifests(directory)]
+
+
+# -------------------------------------------------------- gang checkpointing
+
+class GangCheckpointCoordinator:
+    """The gang-consistent save/resolve protocol over one checkpoint
+    directory. ``client`` is the coordination-service KV surface (None =
+    solo mode: no exchanges, local resolution only — how a shrunk or
+    single-process resume reads a gang directory)."""
+
+    def __init__(self, directory: str, *, client=None, rank: int = 0,
+                 world: int = 1, keep_last_n: int = 3,
+                 timeout_ms: int = 600_000, elastic: bool = False):
+        if not directory:
+            raise CheckpointError("checkpoint_dir is empty — set "
+                                  "checkpoint_dir=... "
+                                  "(docs/Fault-Tolerance.md)")
+        self.directory = directory
+        self.client = client
+        self.rank = int(rank)
+        self.world = int(world)
+        self.keep_last_n = int(keep_last_n)
+        self.timeout_ms = int(timeout_ms)
+        self.elastic = bool(elastic)
+
+    # ------------------------------------------------------------- plumbing
+
+    def _allgather(self, obj, tag: str):
+        """Rank-ordered host allgather over the gang's KV client — the one
+        exchange primitive the whole protocol uses (retries/backoff and
+        timeout attribution live in ``parallel.comm.host_allgather``)."""
+        if self.world <= 1 or self.client is None:
+            return [obj]
+        from ..parallel import comm
+        return comm.host_allgather(obj, tag, timeout_ms=self.timeout_ms,
+                                   client=self.client, rank=self.rank,
+                                   world=self.world)
+
+    def shard_name(self, epoch: int, rank: Optional[int] = None) -> str:
+        return f"shard_{epoch:010d}_r{(self.rank if rank is None else rank):04d}.pkl"
+
+    def manifest_path(self, epoch: int) -> str:
+        return os.path.join(self.directory, f"manifest_{epoch:010d}.json")
+
+    def _local_epochs(self) -> List[int]:
+        epochs = {e for e, _ in list_manifests(self.directory)}
+        if os.path.isdir(self.directory):
+            for name in os.listdir(self.directory):
+                m = _SHARD_RE.match(name)
+                if m:
+                    epochs.add(int(m.group(1)))
+        return sorted(epochs)
+
+    # ----------------------------------------------------------------- save
+
+    def save(self, payload: Dict) -> str:
+        """One gang-consistent checkpoint epoch: write this rank's shard,
+        exchange CRCs, commit the manifest (rank 0 publishes it through the
+        KV store; every rank persists it locally) behind a commit barrier.
+        Returns this rank's shard path."""
+        from .. import observability as _obs
+        os.makedirs(self.directory, exist_ok=True)
+        # every rank proposes max(local epochs)+1 and the gang takes the
+        # max — ranks whose directories diverged (a replaced host with an
+        # empty disk) still agree on one monotonically increasing epoch
+        proposed = (self._local_epochs() or [0])[-1] + 1
+        epoch = max(self._allgather(proposed, "gang_ckpt_epoch"))
+        payload = dict(payload)
+        payload["format_version"] = FORMAT_VERSION
+        payload["checkpoint_id"] = epoch
+        state = payload.get("state", {})
+        with _obs.span("gang_checkpoint", epoch=epoch,
+                       iteration=payload.get("iteration"), rank=self.rank,
+                       world=self.world):
+            shard_file = self.shard_name(epoch)
+            crc, size = write_shard_file(
+                os.path.join(self.directory, shard_file), payload)
+            _obs.inc("gang.shard_writes")
+            meta = {"rank": self.rank, "file": shard_file, "crc32": crc,
+                    "size": size, "iteration": payload.get("iteration")}
+            metas = self._allgather(meta, "gang_ckpt_meta")
+            iters = sorted({m["iteration"] for m in metas})
+            if len(iters) != 1:
+                raise CheckpointError(
+                    f"gang checkpoint epoch {epoch} is torn: ranks disagree "
+                    f"on the iteration ({iters}) — refusing to commit a "
+                    f"mixed-iteration manifest")
+            manifest = {
+                "manifest_version": MANIFEST_VERSION,
+                "epoch": epoch,
+                "iteration": payload.get("iteration"),
+                "world": self.world,
+                "n_devices": state.get("n_devices"),
+                "tree_learner": state.get("tree_learner"),
+                "config_fingerprint": payload.get("config_fingerprint"),
+                "shards": sorted(metas, key=lambda m: m["rank"]),
+            }
+            raw = json.dumps(manifest, sort_keys=True, indent=1).encode()
+            key = f"{_KV_PREFIX}/manifest/{epoch}"
+            if self.client is not None:
+                if self.rank == 0:
+                    # allow_overwrite: a retried commit (or a re-run after a
+                    # failed barrier) re-publishes the identical bytes
+                    retry_call(
+                        lambda: self.client.key_value_set_bytes(
+                            key, raw, allow_overwrite=True),
+                        what=f"gang manifest publish epoch={epoch}")
+                else:
+                    raw = retry_call(
+                        lambda: self.client.blocking_key_value_get_bytes(
+                            key, self.timeout_ms),
+                        what=f"gang manifest fetch epoch={epoch} "
+                             f"rank={self.rank}")
+            # every rank persists the manifest — resume verification is
+            # purely local (each host sees only its own disk on a real pod)
+            _write_bytes_atomic(self.manifest_path(epoch), raw,
+                                discriminator=f".r{self.rank:04d}")
+            if self.client is not None:
+                # the COMMIT barrier: the epoch exists once every rank has
+                # persisted its shard and the manifest
+                try:
+                    self.client.wait_at_barrier(
+                        f"{_KV_PREFIX}/commit/{epoch}", self.timeout_ms)
+                except Exception as e:
+                    _obs.inc("comm.barrier_failures")
+                    raise CheckpointError(
+                        f"gang checkpoint epoch {epoch} commit barrier "
+                        f"failed on rank {self.rank} "
+                        f"({type(e).__name__}: {e}) — a peer did not "
+                        f"persist the epoch") from e
+                if self.rank == 0:
+                    try:
+                        self.client.key_value_delete(key)
+                    except Exception as e:               # noqa: BLE001
+                        Log.debug("gang manifest KV cleanup failed: %s: %s",
+                                  type(e).__name__, e)
+        _obs.inc("gang.manifest_commits")
+        self._prune()
+        Log.info("gang checkpoint epoch %d committed (iteration %s, rank "
+                 "%d/%d, crc %#010x)", epoch, payload.get("iteration"),
+                 self.rank, self.world, crc)
+        return os.path.join(self.directory, shard_file)
+
+    def _prune(self) -> None:
+        """Keep the newest ``keep_last_n`` epochs: each rank unlinks its OWN
+        old shards; rank 0 also unlinks the old manifests (on a shared
+        directory that is exactly one deletion per file)."""
+        if self.keep_last_n <= 0:
+            return
+        keep = set(self._local_epochs()[-self.keep_last_n:])
+        for epoch, path in list_manifests(self.directory):
+            if epoch not in keep and self.rank == 0:
+                try:
+                    os.unlink(path)
+                except OSError as e:
+                    Log.warning("cannot prune gang manifest %s: %s", path, e)
+        for name in os.listdir(self.directory):
+            m = _SHARD_RE.match(name)
+            if m and int(m.group(1)) not in keep \
+                    and int(m.group(2)) == self.rank:
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                except OSError as e:
+                    Log.warning("cannot prune gang shard %s: %s", name, e)
+
+    # -------------------------------------------------------------- resolve
+
+    def local_verified_epochs(self) -> List[int]:
+        """Epochs whose manifest parses AND whose shard for THIS rank is
+        present with a matching CRC — what this rank can vouch for."""
+        out = []
+        for epoch, path in list_manifests(self.directory):
+            ok, detail = verify_manifest(path, self.directory,
+                                         only_rank=self.rank)
+            if ok:
+                out.append(epoch)
+            else:
+                Log.warning("gang epoch %d is not verifiable on rank %d "
+                            "(%s)", epoch, self.rank, detail)
+        return out
+
+    def resolve_resume(self) -> Optional[str]:
+        """The gang half of ``resume_from=auto``: the newest epoch EVERY
+        rank can verify locally, agreed through an allgather of the
+        verified-epoch sets. Returns this rank's shard path for that epoch,
+        or None when the directory holds no manifests at all (fresh start).
+        Raises when manifests exist but no common verifiable epoch does —
+        silently retraining a gang from scratch is the torn-resume this
+        protocol exists to prevent."""
+        from .. import observability as _obs
+        manifests = list_manifests(self.directory)
+        local = self.local_verified_epochs()
+        newest_known = manifests[-1][0] if manifests else 0
+        views = self._allgather((sorted(local), newest_known), "gang_resume")
+        common = set(views[0][0])
+        for epochs, _ in views[1:]:
+            common &= set(epochs)
+        anyone_knows = max(v[1] for v in views)
+        if not common:
+            if anyone_knows:
+                raise CheckpointError(
+                    f"gang resume: manifests exist under {self.directory} "
+                    f"(newest epoch {anyone_knows}) but no epoch verifies "
+                    f"on every rank — refusing to silently retrain from "
+                    f"scratch; audit with `python -m "
+                    f"lightgbm_tpu.robustness.checkpoint --verify "
+                    f"{self.directory}` on each host")
+            return None
+        epoch = max(common)
+        if epoch < anyone_knows:
+            _obs.get_registry().counter("fault.gang_fallback_epochs").inc(
+                anyone_knows - epoch)
+            Log.warning("gang resume: falling back TOGETHER from epoch %d "
+                        "to %d — some rank cannot verify the newer "
+                        "epoch(s); a mixed-iteration resume is never "
+                        "attempted", anyone_knows, epoch)
+        manifest = load_manifest(self.manifest_path(epoch))
+        if manifest.get("world") != self.world:
+            if not self.elastic:
+                Log.fatal(
+                    "gang resume: epoch %d was written by a %s-rank gang "
+                    "but this gang has %d rank(s). Elastic resume on a "
+                    "different world size must be EXPLICIT: set "
+                    "elastic=true (plus tpu_reshard_on_resume=true for the "
+                    "device re-layout) or restart the original fleet "
+                    "(docs/Fault-Tolerance.md)",
+                    epoch, manifest.get("world"), self.world)
+            Log.warning("gang resume (elastic): epoch %d written under "
+                        "world=%s, resuming under world=%d via the "
+                        "tpu_reshard_on_resume path",
+                        epoch, manifest.get("world"), self.world)
+        shard = os.path.join(self.directory, self.shard_name(epoch))
+        Log.info("gang resume: epoch %d agreed by all %d rank(s) — "
+                 "resuming rank %d from %s", epoch, self.world, self.rank,
+                 os.path.basename(shard))
+        return shard
+
+
+# ---------------------------------------------------------- heartbeat leases
+
+class HeartbeatLease:
+    """Per-rank liveness lease in the coordination-service KV store.
+
+    ``beat()`` (called at the same dispatch boundaries the watchdog's
+    heartbeat uses) bumps this rank's sequence number; writes are
+    rate-limited to ``interval_s``. ``probe()`` is the pre-wave liveness
+    check: peers whose sequence has not advanced for ``lease_timeout_s`` —
+    by THIS process's monotonic clock, so cross-host clock skew is
+    irrelevant — raise a typed :class:`PeerLostError` naming the rank
+    BEFORE the collective is entered. ``attribution()`` is the non-raising
+    variant the hang watchdog calls at firing time to name the
+    slowest/missing rank.
+    """
+
+    def __init__(self, *, client, rank: int, world: int,
+                 lease_timeout_s: float, interval_s: float = 0.0,
+                 probe_timeout_ms: int = 200,
+                 clock: Callable[[], float] = time.monotonic):
+        if lease_timeout_s <= 0:
+            raise ValueError(f"lease_timeout_s must be > 0, "
+                             f"got {lease_timeout_s}")
+        self.client = client
+        self.rank = int(rank)
+        self.world = int(world)
+        self.lease_timeout_s = float(lease_timeout_s)
+        self.interval_s = float(interval_s)
+        self.probe_timeout_ms = int(probe_timeout_ms)
+        self._clock = clock
+        self._seq = 0
+        self._last_write: Optional[float] = None
+        self._last_probe: Optional[float] = None
+        # rank -> (last seen seq, local time the seq last ADVANCED)
+        self._peer_seen: Dict[int, Tuple[int, float]] = {}
+        self._started = clock()
+
+    def _key(self, rank: int) -> str:
+        return f"{_KV_PREFIX}/hb/{rank}"
+
+    # ---------------------------------------------------------------- beats
+
+    def beat(self, force: bool = False) -> bool:
+        """Bump this rank's lease (rate-limited; ``force`` ignores the
+        interval). Returns True when a KV write actually happened."""
+        now = self._clock()
+        if not force and self._last_write is not None \
+                and now - self._last_write < self.interval_s:
+            return False
+        self._seq += 1
+        try:
+            self.client.key_value_set_bytes(
+                self._key(self.rank), str(self._seq).encode(),
+                allow_overwrite=True)
+        except Exception as e:                               # noqa: BLE001
+            # a failed beat must never take the training loop down — the
+            # peers' lease timeout covers a beat-less stretch, and the next
+            # boundary retries naturally
+            Log.warning("heartbeat beat failed on rank %d (%s: %s) — "
+                        "peers' lease timeout covers the gap",
+                        self.rank, type(e).__name__, e)
+            return False
+        self._last_write = now
+        from .. import observability as _obs
+        _obs.inc("comm.heartbeat_beats")
+        return True
+
+    # --------------------------------------------------------------- probes
+
+    def _peer_ages(self) -> Dict[int, float]:
+        """Seconds since each peer's lease last advanced (by this process's
+        clock; a peer that never wrote ages from probe start)."""
+        now = self._clock()
+        ages: Dict[int, float] = {}
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            seq = None
+            try:
+                raw = self.client.blocking_key_value_get_bytes(
+                    self._key(r), self.probe_timeout_ms)
+                seq = int(raw)
+            except Exception as e:                           # noqa: BLE001
+                # no lease yet, or a KV hiccup: the peer simply keeps aging
+                # by OUR clock — exactly the failure the lease measures
+                Log.debug("heartbeat probe: no lease read for rank %d "
+                          "(%s: %s)", r, type(e).__name__, e)
+            prev = self._peer_seen.get(r)
+            if seq is not None and (prev is None or seq != prev[0]):
+                self._peer_seen[r] = (seq, now)
+                ages[r] = 0.0
+            else:
+                ages[r] = now - (prev[1] if prev is not None
+                                 else self._started)
+        return ages
+
+    def check_peers(self) -> Dict[int, float]:
+        """One liveness pass over every peer; raises PeerLostError for the
+        stalest expired lease. Returns the age map when all peers live."""
+        from .. import observability as _obs
+        ages = self._peer_ages()
+        if ages:
+            slowest = max(ages, key=lambda r: ages[r])
+            _obs.get_registry().gauge("comm.slowest_rank").set(slowest)
+            if ages[slowest] > self.lease_timeout_s:
+                _obs.inc("fault.peer_lost")
+                raise PeerLostError(
+                    f"peer rank {slowest} is lost: heartbeat lease has not "
+                    f"advanced for {ages[slowest]:.1f}s "
+                    f"(gang_lease_timeout_s={self.lease_timeout_s:g}) — "
+                    f"detected before entering the collective",
+                    rank=slowest)
+        return ages
+
+    def probe(self) -> Optional[Dict[int, float]]:
+        """The pre-wave probe: rate-limited to ``interval_s`` so steady
+        state costs at most one KV get per peer per interval. Returns the
+        age map when a probe ran, None when rate-limited."""
+        now = self._clock()
+        if self._last_probe is not None \
+                and now - self._last_probe < self.interval_s:
+            return None
+        self._last_probe = now
+        return self.check_peers()
+
+    def attribution(self) -> Dict:
+        """Watchdog hook: probe the leases WITHOUT raising and report who
+        is slowest/lost — the watchdog folds this into its dump and, when
+        a peer is lost, aborts with exit 145 (comm loss) instead of the
+        generic hang code. Never raises."""
+        from .. import observability as _obs
+        try:
+            ages = self._peer_ages()
+        except Exception as e:                               # noqa: BLE001
+            return {"error": f"{type(e).__name__}: {e}"}
+        out: Dict = {"peer_lease_ages_s": {str(r): round(a, 3)
+                                           for r, a in ages.items()},
+                     "lease_timeout_s": self.lease_timeout_s,
+                     "slowest_rank": None, "peer_lost": None}
+        if ages:
+            slowest = max(ages, key=lambda r: ages[r])
+            out["slowest_rank"] = slowest
+            _obs.get_registry().gauge("comm.slowest_rank").set(slowest)
+            if ages[slowest] > self.lease_timeout_s:
+                out["peer_lost"] = slowest
+                _obs.inc("fault.peer_lost")
+        return out
+
+    def withdraw(self) -> None:
+        """Delete this rank's lease key (clean shutdown: peers see a
+        missing lease age out instead of a frozen one). Best-effort."""
+        try:
+            self.client.key_value_delete(self._key(self.rank))
+        except Exception as e:                               # noqa: BLE001
+            Log.debug("heartbeat withdraw failed: %s: %s",
+                      type(e).__name__, e)
+
+
+# ------------------------------------------------- mid-wave loss attribution
+
+# substrings of the raw runtime errors a COLLECTIVE dies with when a peer
+# process disappears mid-wave (gloo TCP resets on CPU gangs, the
+# coordination service declaring a task unhealthy, ICI/DCN transport
+# failures) — the failures the pre-wave probe is too early to see
+_COMM_LOSS_SIGNATURES = (
+    "gloo",
+    "connection reset by peer",
+    "connection refused",
+    "socket closed",
+    "peer closed",
+    "heartbeat timeout",
+    "coordination service",
+    "distributed service",
+    "preempt",
+)
+
+
+def comm_loss_error(exc: BaseException,
+                    lease: Optional[HeartbeatLease] = None):
+    """Map a raw error raised INSIDE a collective wave (XlaRuntimeError
+    from a gloo reset, a coordination-service health poll, ...) onto the
+    typed comm-loss errors, consulting the heartbeat leases for WHO died:
+    a dead peer surfaces as :class:`PeerLostError` naming the rank, an
+    unattributable transport loss as ``CommTimeoutError`` — either way the
+    CLI exits 145 (comm loss) so the fleet supervisor attributes the
+    survivor correctly instead of reading a crash. Returns None when the
+    error does not look like a comm loss (re-raise the original)."""
+    from .retry import CommTimeoutError
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    if not any(sig in msg for sig in _COMM_LOSS_SIGNATURES):
+        return None
+    att = lease.attribution() if lease is not None else {}
+    lost = att.get("peer_lost")
+    suspect = att.get("slowest_rank")
+    detail = f"{type(exc).__name__}: {exc}"
+    if len(detail) > 500:
+        detail = detail[:500] + "..."
+    if lost is not None:
+        return PeerLostError(
+            f"collective failed mid-wave: peer rank {lost}'s heartbeat "
+            f"lease expired ({detail})", rank=lost)
+    if suspect is not None:
+        return PeerLostError(
+            f"collective failed mid-wave: transport to a peer died — "
+            f"slowest lease is rank {suspect} ({detail})", rank=suspect)
+    return CommTimeoutError(f"collective failed mid-wave: {detail}")

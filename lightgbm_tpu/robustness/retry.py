@@ -41,6 +41,17 @@ class CommTimeoutError(CommRetryError):
     names the tag/sequence and both ranks involved."""
 
 
+class PeerLostError(CommTimeoutError):
+    """A specific peer rank is gone — its heartbeat lease expired or it
+    never answered inside the collective deadline. ``rank`` names the lost
+    peer so fleet restart policy can attribute the failure (exit code 145
+    at the top level, vs the generic hang's 142)."""
+
+    def __init__(self, message: str, *, rank: int):
+        super().__init__(message)
+        self.rank = int(rank)
+
+
 def _env_int(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, default))

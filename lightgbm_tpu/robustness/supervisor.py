@@ -33,7 +33,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..utils.log import Log
 from .checkpoint import CheckpointManager
-from .watchdog import EXIT_HANG
+from .watchdog import EXIT_COMM_LOST, EXIT_HANG
 
 # exit status the CLI uses for a detected stream-shard corruption
 # (ops/stream.py ShardCorruptionError): restartable — the host shard store
@@ -46,6 +46,8 @@ _EXIT_LABELS = {
     EXIT_SIGTERM_CHECKPOINT: "checkpoint-then-exit (SIGTERM/preemption)",
     EXIT_HANG: "watchdog abort-to-checkpoint (hang)",
     EXIT_SHARD_CORRUPT: "stream-shard corruption",
+    EXIT_COMM_LOST: "comm loss (PeerLostError/CommTimeoutError: a peer "
+                    "rank died or stopped answering)",
     -9: "SIGKILL",
     -15: "SIGTERM (no handler)",
     -6: "SIGABRT",
@@ -220,13 +222,308 @@ class Supervisor:
                 "checkpoint_dir": self.checkpoint_dir}
 
 
+class FleetSupervisor:
+    """Gang supervisor for a whole multi-process training fleet
+    (``--fleet=N``; docs/Fault-Tolerance.md "Distributed fault tolerance").
+
+    Launches ``world`` rank processes from one argv template (tokens may
+    carry ``{rank}``/``{world}`` placeholders), watches them as a GANG: the
+    first nonzero exit fails the whole gang — the survivors are reaped (a
+    rank whose peer died is already dying with exit 145 anyway) and the
+    gang is relaunched with ``resume_from=auto`` under bounded restarts,
+    resuming from the newest gang-consistent manifest.
+
+    Failure ATTRIBUTION uses the exit-code classes: a rank exiting
+    :data:`EXIT_COMM_LOST` (145) is a *survivor reporting a lost peer*,
+    never the culprit; the culprit is the rank with any other failure
+    (``kill -9`` shows as -9). A rank failing ``rank_dead_after``
+    consecutive gang incidents is declared DEAD: with ``elastic=True`` the
+    fleet shrinks by one rank and relaunches (the children get
+    ``elastic=true tpu_reshard_on_resume=true`` appended, engaging the
+    manifest world-size check and the deliberate re-shard); without it the
+    supervisor REFUSES loudly and exits 145 — shrinking a fleet is never
+    implicit.
+
+    Fleet MTTR mirrors :class:`Supervisor`: failure time -> first NEW
+    checkpoint id or manifest epoch banked after the relaunch, recorded in
+    ``fault.recovery_seconds``. ``spawn_fn``/``sleep``/``clock``/
+    ``pre_launch_fn`` are injectable for tests and the chaos bench
+    (``pre_launch_fn(world, generation) -> [extra argv tokens]`` — e.g.
+    fresh coordinator ports per gang generation)."""
+
+    def __init__(self, argv_template: List[str], world: int, *,
+                 max_restarts: int = 5,
+                 backoff_base_s: float = 1.0,
+                 backoff_max_s: float = 60.0,
+                 jitter: float = 0.25,
+                 seed: Optional[int] = None,
+                 poll_interval_s: float = 0.05,
+                 elastic: bool = False,
+                 rank_dead_after: int = 2,
+                 min_world: int = 1,
+                 reap_grace_s: float = 10.0,
+                 pre_launch_fn: Optional[Callable] = None,
+                 spawn_fn: Optional[Callable] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Optional[Callable[[], float]] = None):
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        if rank_dead_after < 1:
+            raise ValueError(f"rank_dead_after must be >= 1, "
+                             f"got {rank_dead_after}")
+        self.argv_template = list(argv_template)
+        self.world = int(world)
+        self.max_restarts = max_restarts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.jitter = jitter
+        self.poll_interval_s = poll_interval_s
+        self.elastic = bool(elastic)
+        self.rank_dead_after = int(rank_dead_after)
+        self.min_world = int(min_world)
+        self.reap_grace_s = float(reap_grace_s)
+        self._pre_launch = pre_launch_fn
+        self._rng = random.Random(seed) if seed is not None else random
+        self._spawn = spawn_fn or Supervisor._spawn_child
+        self._sleep = sleep
+        self._clock = clock
+        params = _train_args_dict(argv_template)
+        self.checkpoint_dir = params.get("checkpoint_dir", "")
+        if not self.checkpoint_dir:
+            Log.warning("fleet supervisor: no checkpoint_dir in the train "
+                        "template — a relaunched gang retrains FROM "
+                        "SCRATCH every time (set checkpoint_dir=... + "
+                        "checkpoint_interval=N; docs/Fault-Tolerance.md)")
+        self._appended: List[str] = []
+        if params.get("resume_from") != "auto":
+            self._appended.append("resume_from=auto")
+        self.restarts = 0
+        self.generation = 0
+        self.shrinks = 0
+        self.recovery_seconds: List[float] = []
+        self.gang_exit_codes: List[Dict[int, int]] = []
+        self._consecutive_fails: Dict[int, int] = {}
+
+    def _now(self) -> float:
+        if self._clock is not None:
+            return self._clock()
+        from .. import observability as _obs
+        return _obs.clock()
+
+    def _newest_id(self) -> int:
+        """Newest persisted recovery point: max over single-process
+        checkpoint ids AND gang manifest epochs (whichever flavor the gang
+        writes, banking a NEWER one marks the failure healed)."""
+        if not self.checkpoint_dir:
+            return -1
+        from .distributed import list_manifests
+        ids = [e for e, _ in list_manifests(self.checkpoint_dir)]
+        ids += [i for i, _ in
+                CheckpointManager(self.checkpoint_dir).list_checkpoints()]
+        return max(ids, default=0)
+
+    def _materialize(self) -> List[List[str]]:
+        """Per-rank argvs for the current generation: template + appended
+        + pre-launch extras, with {rank}/{world} substituted."""
+        extra = (list(self._pre_launch(self.world, self.generation))
+                 if self._pre_launch else [])
+        toks = self.argv_template + extra + self._appended
+        return [[t.format(rank=rank, world=self.world) for t in toks]
+                for rank in range(self.world)]
+
+    def _reap(self, procs, rcs) -> set:
+        """Collect the whole gang after a failure. Survivors get
+        ``reap_grace_s`` to exit on their OWN (a rank whose peer died is
+        already dying with exit 145 — its self-reported code is the
+        attribution signal), then are terminated and finally killed.
+        Returns the set of ranks that had to be force-reaped — their exit
+        codes are the supervisor's doing, not the rank's, and are excluded
+        from culprit attribution."""
+        deadline = self._now() + self.reap_grace_s
+        while any(rc is None for rc in rcs):
+            for i, p in enumerate(procs):
+                if rcs[i] is None:
+                    rcs[i] = p.poll()
+            if all(rc is not None for rc in rcs) \
+                    or self._now() >= deadline:
+                break
+            self._sleep(self.poll_interval_s)
+        reaped = {i for i, rc in enumerate(rcs) if rc is None}
+        for i in sorted(reaped):
+            try:
+                procs[i].terminate()
+            except Exception as e:                           # noqa: BLE001
+                Log.debug("fleet: terminate rank %d failed: %s", i, e)
+        deadline = self._now() + self.reap_grace_s
+        while any(rcs[i] is None for i in reaped):
+            for i in reaped:
+                if rcs[i] is None:
+                    rcs[i] = procs[i].poll()
+            if all(rcs[i] is not None for i in reaped):
+                break
+            if self._now() >= deadline:
+                for i in reaped:
+                    if rcs[i] is None:
+                        try:
+                            procs[i].kill()
+                        except Exception as e:               # noqa: BLE001
+                            Log.debug("fleet: kill rank %d failed: %s", i, e)
+                        rcs[i] = procs[i].poll()
+                break
+            self._sleep(self.poll_interval_s)
+        return reaped
+
+    def run(self) -> int:
+        """Supervise the gang until it completes cleanly or the restart
+        budget is exhausted; returns the final exit code (0 = success)."""
+        from .. import observability as _obs
+        reg = _obs.get_registry()
+        pending_fail_t: Optional[float] = None
+        id_at_fail = -1
+        while True:
+            argvs = self._materialize()
+            Log.info("fleet supervisor: launching gang generation %d "
+                     "(world %d)", self.generation, self.world)
+            procs = [self._spawn(a) for a in argvs]
+            rcs: List[Optional[int]] = [None] * self.world
+            first_bad: Dict[int, int] = {}
+            while True:
+                if pending_fail_t is not None and self.checkpoint_dir:
+                    cur = self._newest_id()
+                    if cur > id_at_fail:
+                        mttr = self._now() - pending_fail_t
+                        self.recovery_seconds.append(mttr)
+                        reg.histogram("fault.recovery_seconds").observe(mttr)
+                        _obs.event("fleet_recovered", recovery_point=cur,
+                                   world=self.world,
+                                   recovery_seconds=round(mttr, 3))
+                        Log.info("fleet supervisor: recovered — recovery "
+                                 "point %d banked %.2fs after the failure "
+                                 "(fleet MTTR)", cur, mttr)
+                        pending_fail_t = None
+                for i, p in enumerate(procs):
+                    if rcs[i] is None:
+                        rcs[i] = p.poll()
+                first_bad = {i: rc for i, rc in enumerate(rcs)
+                             if rc is not None and rc != 0}
+                if first_bad or all(rc == 0 for rc in rcs):
+                    break
+                self._sleep(self.poll_interval_s)
+            if not first_bad:
+                if pending_fail_t is not None:
+                    mttr = self._now() - pending_fail_t
+                    self.recovery_seconds.append(mttr)
+                    reg.histogram("fault.recovery_seconds").observe(mttr)
+                Log.info("fleet supervisor: gang completed cleanly after "
+                         "%d restart(s), %d shrink(s)",
+                         self.restarts, self.shrinks)
+                return 0
+            # gang failure: give survivors their grace to self-report (a
+            # peer-loss exit 145 is attribution data), then attribute
+            reaped = self._reap(procs, rcs)
+            self.gang_exit_codes.append(
+                {i: rc for i, rc in enumerate(rcs) if rc is not None})
+            reg.inc("fault.fleet_gang_failures")
+            for i, rc in sorted(first_bad.items()):
+                Log.warning("fleet supervisor: rank %d failed first with "
+                            "%s", i, describe_exit(rc))
+            # exit 145 = a survivor REPORTING the loss, never the culprit;
+            # a force-reaped rank's code is the supervisor's own SIGTERM
+            culprits = sorted(
+                i for i, rc in enumerate(rcs)
+                if rc not in (None, 0, EXIT_COMM_LOST) and i not in reaped)
+            for i in range(self.world):
+                if i in culprits:
+                    self._consecutive_fails[i] = \
+                        self._consecutive_fails.get(i, 0) + 1
+                else:
+                    self._consecutive_fails[i] = 0
+            _obs.event("fleet_gang_failed", generation=self.generation,
+                       exit_codes={str(i): rc for i, rc in enumerate(rcs)
+                                   if rc is not None},
+                       culprits=culprits)
+            dead = sorted(i for i, n in self._consecutive_fails.items()
+                          if n >= self.rank_dead_after)
+            if dead:
+                if not self.elastic:
+                    Log.warning(
+                        "fleet supervisor: rank(s) %s failed %d consecutive "
+                        "gang incident(s) and look DEAD, but elastic resume "
+                        "is OFF — refusing to shrink the fleet implicitly. "
+                        "Relaunch with --elastic (and children running "
+                        "elastic=true tpu_reshard_on_resume=true) to "
+                        "restart on the surviving device count, or repair "
+                        "the host (exit %d)", dead, self.rank_dead_after,
+                        EXIT_COMM_LOST)
+                    return EXIT_COMM_LOST
+                new_world = self.world - len(dead)
+                if new_world < self.min_world:
+                    Log.warning("fleet supervisor: shrinking past "
+                                "min_world=%d is not possible (dead ranks "
+                                "%s) — giving up (exit %d)", self.min_world,
+                                dead, EXIT_COMM_LOST)
+                    return EXIT_COMM_LOST
+                Log.warning("fleet supervisor: rank(s) %s declared dead — "
+                            "ELASTIC shrink %d -> %d rank(s); children "
+                            "resume from the newest gang-consistent "
+                            "manifest via tpu_reshard_on_resume", dead,
+                            self.world, new_world)
+                self.world = new_world
+                self.shrinks += 1
+                reg.inc("fault.fleet_shrinks")
+                self._consecutive_fails = {}
+                for tok in ("elastic=true", "tpu_reshard_on_resume=true"):
+                    if tok not in self._appended \
+                            and tok not in self.argv_template:
+                        self._appended.append(tok)
+            if self.restarts >= self.max_restarts:
+                worst = max(first_bad.values())
+                Log.warning("fleet supervisor: restart budget (%d) "
+                            "exhausted — giving up with %s",
+                            self.max_restarts, describe_exit(worst))
+                return worst
+            pending_fail_t = self._now()
+            id_at_fail = self._newest_id()
+            self.restarts += 1
+            reg.inc("fault.fleet_restarts")
+            delay = min(self.backoff_base_s * (2.0 ** (self.restarts - 1)),
+                        self.backoff_max_s)
+            delay *= 1.0 + self.jitter * self._rng.random()
+            Log.warning("fleet supervisor: relaunching the gang (restart "
+                        "%d/%d, world %d) with resume_from=auto in %.2fs",
+                        self.restarts, self.max_restarts, self.world, delay)
+            self._sleep(delay)
+            self.generation += 1
+
+    def report(self) -> Dict:
+        return {"restarts": self.restarts,
+                "generations": self.generation,
+                "world": self.world,
+                "shrinks": self.shrinks,
+                "gang_exit_codes": [
+                    {str(i): rc for i, rc in g.items()}
+                    for g in self.gang_exit_codes],
+                "recovery_seconds": [round(s, 3)
+                                     for s in self.recovery_seconds],
+                "checkpoint_dir": self.checkpoint_dir}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI entry. Supervisor options are ``--flag=value`` BEFORE ``--``;
     everything after ``--`` (or the first bare ``key=value``) is the train
-    command handed to ``python -m lightgbm_tpu``."""
+    command handed to ``python -m lightgbm_tpu``. ``--fleet=N`` supervises
+    an N-rank gang through :class:`FleetSupervisor` instead — the train
+    command becomes a per-rank template (``{rank}``/``{world}``
+    placeholders), ``--elastic`` permits shrinking onto the survivors and
+    ``--rank-dead-after=K`` sets how many consecutive gang incidents
+    attribute a rank as dead."""
     argv = sys.argv[1:] if argv is None else list(argv)
     opts = {"max_restarts": 5, "backoff_base_s": 1.0, "backoff_max_s": 60.0,
             "jitter": 0.25, "seed": None}
+    fleet = 0
+    fleet_opts = {"elastic": False, "rank_dead_after": 2}
     train_args: List[str] = []
     i = 0
     while i < len(argv):
@@ -234,6 +531,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         if tok == "--":
             train_args.extend(argv[i + 1:])
             break
+        if tok == "--elastic":
+            fleet_opts["elastic"] = True
+            i += 1
+            continue
         if tok.startswith("--") and "=" in tok:
             k, v = tok[2:].split("=", 1)
             k = k.replace("-", "_")
@@ -245,14 +546,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                 opts[k] = float(v)
                 i += 1
                 continue
+            if k == "fleet":
+                fleet = int(v)
+                i += 1
+                continue
+            if k == "rank_dead_after":
+                fleet_opts["rank_dead_after"] = int(v)
+                i += 1
+                continue
+            if k == "elastic":
+                fleet_opts["elastic"] = v.strip().lower() in (
+                    "1", "true", "yes", "on")
+                i += 1
+                continue
         train_args.append(tok)
         i += 1
     if not train_args:
         print("usage: python -m lightgbm_tpu.robustness.supervisor "
               "[--max-restarts=N] [--backoff-base-s=F] [--backoff-max-s=F] "
-              "[--jitter=F] [--seed=N] -- <lightgbm_tpu CLI args>",
+              "[--jitter=F] [--seed=N] [--fleet=N [--elastic] "
+              "[--rank-dead-after=K]] -- <lightgbm_tpu CLI args>",
               file=sys.stderr)
         return 2
+    if fleet > 0:
+        fsup = FleetSupervisor(train_args, fleet, **opts, **fleet_opts)
+        rc = fsup.run()
+        frep = fsup.report()
+        Log.info("fleet supervisor: done (exit %d): %d restart(s), "
+                 "%d shrink(s), world %d, recovery_seconds=%s", rc,
+                 frep["restarts"], frep["shrinks"], frep["world"],
+                 frep["recovery_seconds"])
+        return rc
     sup = Supervisor(train_args, **opts)
     rc = sup.run()
     rep = sup.report()

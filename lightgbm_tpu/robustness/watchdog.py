@@ -45,6 +45,13 @@ from ..utils.log import Log
 # names the failure class it is recovering from
 EXIT_HANG = 142
 
+# exit status when the failure is attributed to COMM LOSS — a lost/dead
+# peer rank (PeerLostError/CommTimeoutError at top level, or a watchdog
+# firing whose lease attribution names a lost peer). Distinct from the
+# generic hang so fleet restart policy (supervisor.py --fleet) can tell
+# "my peer died" (restart the gang) from "I wedged locally"
+EXIT_COMM_LOST = 145
+
 
 class HangWatchdog:
     """Heartbeat-fed hang detector over the training loop's dispatch
@@ -59,7 +66,8 @@ class HangWatchdog:
                  poll_interval_s: Optional[float] = None,
                  startup_grace_s: Optional[float] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 abort_fn: Optional[Callable[[], None]] = None):
+                 abort_fn: Optional[Callable[[], None]] = None,
+                 attribution_fn: Optional[Callable[[], Dict]] = None):
         if timeout_s <= 0:
             raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
         if action not in ("dump", "abort"):
@@ -84,6 +92,12 @@ class HangWatchdog:
                                 else min(1.0, self.timeout_s / 4.0))
         self._clock = clock
         self._abort_fn = abort_fn
+        # multi-host attribution hook (robustness/distributed.py
+        # HeartbeatLease.attribution): called at firing time to probe the
+        # peers' heartbeat leases, so a hang caused by a DEAD PEER is named
+        # (rank + lease age in the log and dump) and aborts with
+        # EXIT_COMM_LOST instead of the generic EXIT_HANG
+        self.attribution_fn = attribution_fn
         self._lock = threading.Lock()
         self._intervals: deque = deque(maxlen=32)
         self._last_beat: Optional[float] = None
@@ -163,16 +177,37 @@ class HangWatchdog:
             "last iteration %s) — the training loop looks wedged "
             "(hung collective? stuck transfer?)",
             stalled_s, threshold, iteration)
+        attribution = None
+        if self.attribution_fn is not None:
+            try:
+                attribution = self.attribution_fn()
+            except Exception as e:                           # noqa: BLE001
+                Log.warning("watchdog: peer attribution probe failed: "
+                            "%s: %s", type(e).__name__, e)
+        lost_rank = (attribution or {}).get("peer_lost")
+        if lost_rank is not None:
+            Log.warning(
+                "watchdog: the stall is attributed to LOST PEER rank %s — "
+                "its heartbeat lease stopped advancing (%s) — treating as "
+                "comm loss, not a local hang", lost_rank,
+                (attribution or {}).get("peer_lease_ages_s"))
+        elif attribution and attribution.get("slowest_rank") is not None:
+            Log.warning("watchdog: all peer leases still advancing; "
+                        "slowest peer is rank %s (lease ages %s)",
+                        attribution["slowest_rank"],
+                        attribution.get("peer_lease_ages_s"))
         path = None
         if len(self.dumps) < self.max_dumps:
             with _obs.span("watchdog_dump", stalled_s=round(stalled_s, 3),
                            iteration=iteration):
-                path = self._dump(stalled_s, threshold, iteration)
+                path = self._dump(stalled_s, threshold, iteration,
+                                  attribution)
         if self.action == "abort":
-            self._abort(path)
+            self._abort(path, lost_rank=lost_rank)
 
     def _dump(self, stalled_s: float, threshold: float,
-              iteration: Optional[int]) -> Optional[str]:
+              iteration: Optional[int],
+              attribution: Optional[Dict] = None) -> Optional[str]:
         """Write the diagnostic snapshot: every thread's current stack plus
         the full observability snapshot. Never raises — a failed dump must
         not mask the hang handling itself."""
@@ -191,6 +226,7 @@ class HangWatchdog:
             "stalled_seconds": round(stalled_s, 3),
             "threshold_seconds": round(threshold, 3),
             "action": self.action,
+            "peer_attribution": attribution,
             "thread_stacks": stacks,
             "snapshot": _obs.snapshot(),
         }
@@ -209,14 +245,18 @@ class HangWatchdog:
         Log.warning("watchdog: diagnostic dump written to %s", path)
         return path
 
-    def _abort(self, dump_path: Optional[str]) -> None:
+    def _abort(self, dump_path: Optional[str],
+               lost_rank: Optional[int] = None) -> None:
         from .. import observability as _obs
         _obs.inc("fault.hang_aborts")
+        exit_code = EXIT_HANG if lost_rank is None else EXIT_COMM_LOST
         Log.warning(
-            "watchdog: aborting to the last checkpoint (exit %d) — restart "
-            "with resume_from=auto, or run under "
+            "watchdog: aborting to the last checkpoint (exit %d%s) — "
+            "restart with resume_from=auto, or run under "
             "`python -m lightgbm_tpu.robustness.supervisor` which does so "
-            "automatically%s", EXIT_HANG,
+            "automatically%s", exit_code,
+            "" if lost_rank is None
+            else f", comm loss attributed to peer rank {lost_rank}",
             f" (diagnostics: {dump_path})" if dump_path else "")
         try:
             _obs.flush()
@@ -230,7 +270,7 @@ class HangWatchdog:
         # internals): a normal exit path can deadlock behind it, so leave
         # without running interpreter teardown — the atomic checkpoint on
         # disk is the state that matters
-        os._exit(EXIT_HANG)
+        os._exit(exit_code)
 
     # -------------------------------------------------------------- monitor
 

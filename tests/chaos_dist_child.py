@@ -1,0 +1,116 @@
+"""One rank of a chaos-dist gang (bench.py --chaos-dist, FleetSupervisor
+unit tests): trains data-parallel over a real N-process jax.distributed
+CPU cluster with gang-consistent checkpoints, heartbeat leases, and the
+hang watchdog armed — and can SIGKILL ITSELF mid-run once the gang has
+banked a given number of epoch manifests (the scripted 'one rank dies
+mid-epoch' fault).
+
+All arguments are ``key=value`` tokens (FleetSupervisor materializes them
+from its argv template, so ``{rank}``/``{world}`` placeholders and the
+appended ``resume_from=auto``/``elastic=true`` tokens arrive here):
+
+    rank=0 world=2 ports=P0,P1 checkpoint_dir=DIR out_model=PATH
+    rounds=12 [kill_rank=1] [kill_after_manifests=2] [kill_marker=PATH]
+    [resume_from=auto] [elastic=true] [tpu_reshard_on_resume=true]
+
+The self-kill fires only when ``kill_marker`` does not exist yet — the
+marker is created right before arming, so the RELAUNCHED generation of
+the same rank trains through. A killed rank leaves its peers to detect
+the loss: the heartbeat lease stops advancing, the survivors' watchdog
+fires, attribution names this rank, and they exit 145 (EXIT_COMM_LOST).
+"""
+import os
+import signal
+import sys
+import threading
+import time
+
+args = {}
+for tok in sys.argv[1:]:
+    if "=" in tok:
+        k, v = tok.split("=", 1)
+        args[k.strip().lstrip("-").replace("-", "_")] = v.strip()
+
+rank = int(args["rank"])
+world = int(args["world"])
+ports = [int(p) for p in args["ports"].split(",")]
+ckpt_dir = args["checkpoint_dir"]
+out_model = args["out_model"]
+rounds = int(args.get("rounds", "12"))
+
+import numpy as np  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+rng = np.random.RandomState(7)
+X = rng.rand(4000, 10)
+y = X[:, 0] * 3 + X[:, 1] ** 2 + 0.1 * rng.randn(4000)
+
+params = {
+    "objective": "regression", "verbose": -1, "num_leaves": 15,
+    "min_data_in_leaf": 20, "max_bin": 63, "device": "cpu",
+    "seed": 17,
+    "checkpoint_dir": ckpt_dir, "checkpoint_interval": 2,
+    # peer failure detection: tight lease + abort-to-checkpoint watchdog
+    # so a surviving rank turns its wedged collective into exit 145
+    "gang_heartbeat_interval_s": 0.05,
+    "gang_lease_timeout_s": 3.0,
+    "hang_timeout_s": 8.0,
+    "hang_median_factor": 0.0,
+    "hang_action": "abort",
+}
+if world > 1:
+    params.update({
+        "tree_learner": "data", "num_machines": world,
+        "machines": ",".join(f"127.0.0.1:{p}" for p in ports[:world]),
+        "local_listen_port": ports[rank],
+    })
+for k in ("resume_from", "elastic", "tpu_reshard_on_resume"):
+    if k in args:
+        params[k] = args[k]
+
+marker = args.get("kill_marker", "")
+if (int(args.get("kill_rank", "-1")) == rank
+        and not (marker and os.path.exists(marker))):
+    if marker:
+        with open(marker, "w") as fh:
+            fh.write(str(os.getpid()))
+    n_kill = int(args.get("kill_after_manifests", "2"))
+    from lightgbm_tpu.robustness.distributed import list_manifests
+
+    def _suicide():
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            if len(list_manifests(ckpt_dir)) >= n_kill:
+                os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(0.02)
+
+    threading.Thread(target=_suicide, name="chaos-self-kill",
+                     daemon=True).start()
+
+from lightgbm_tpu.robustness.retry import (  # noqa: E402
+    CommRetryError, PeerLostError)
+from lightgbm_tpu.robustness.watchdog import EXIT_COMM_LOST  # noqa: E402
+
+try:
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=rounds)
+except CommRetryError as e:
+    # same contract as cli.run_train: a lost/wedged peer is exit 145 so
+    # FleetSupervisor attributes this rank as SURVIVOR, not culprit.
+    # os._exit, not sys.exit: jax's atexit shutdown blocks on its shutdown
+    # barrier waiting for the DEAD peer, and the coordination service then
+    # aborts the process (-6) — which would misattribute this rank as a
+    # crash culprit
+    who = (f"lost peer rank {e.rank}" if isinstance(e, PeerLostError)
+           else "collective deadline expired")
+    print(f"rank {rank}/{world} comm loss ({who}): {e}", flush=True)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(EXIT_COMM_LOST)
+
+import jax  # noqa: E402
+
+if world <= 1 or jax.process_index() == 0:
+    bst.save_model(out_model)
+print(f"rank {rank}/{world} done", flush=True)

@@ -1,0 +1,30 @@
+"""Fixture: direct coordination-service KV client calls outside the comm
+layer (R013) — bypasses retry, partial-init reset, and chaos injection."""
+
+
+def _client():
+    from jax._src import distributed
+    return distributed.global_state.client
+
+
+def publish_progress(iteration):
+    client = _client()
+    # R013: raw set — no retry_call, invisible to ChaosKVClient
+    client.key_value_set_bytes(f"progress/{iteration}", b"done",
+                               allow_overwrite=True)
+
+
+def wait_for_peers(tag):
+    client = _client()
+    # R013: raw barrier with no deadline attribution
+    client.wait_at_barrier(f"sync/{tag}", timeout_in_ms=60_000)
+    # R013: raw blocking get — hangs untyped on the first KV flap
+    return client.blocking_key_value_get(f"result/{tag}", 60_000)
+
+
+class ProgressBoard:
+    def __init__(self, client):
+        self._kv = client
+
+    def clear(self, key):
+        self._kv.key_value_delete(key)     # R013: raw delete on a handle

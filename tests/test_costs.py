@@ -311,8 +311,8 @@ def test_ledger_builds_from_checked_in_history():
     assert len(entries) >= 10
     doc = ledger.build_ledger(REPO)
     key = ("platform=tpu|rows=10500000|kernel=xla|n_devices=None"
-           "|residency=None|serve=None|serve_chaos=None|bundle=None"
-           "|linear=None")
+           "|residency=None|serve=None|serve_chaos=None|chaos_dist=None"
+           "|bundle=None|linear=None")
     assert doc["best"][key]["value"] == 6.0
     assert doc["best"][key]["source"] == "BENCH_r05.json"
     # the committed ledger matches the history (no drift) — the same

@@ -1,0 +1,480 @@
+"""Distributed fault tolerance: gang-consistent checkpoints, heartbeat
+leases, elastic resume, and the fleet supervisor
+(docs/Fault-Tolerance.md "Distributed fault tolerance").
+
+Gangs are simulated in-process: one FakeKVStore(world=2) backs two rank
+threads for the checkpoint protocol, fake clocks drive the lease timeouts,
+and FakeProc plans drive FleetSupervisor's restart/attribution policy.
+The REAL multi-process arms (jax.distributed gangs, kill -9, elastic
+8->4) live in `bench.py --chaos-dist` / `make chaos-dist`.
+"""
+import itertools
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel import comm
+from lightgbm_tpu.robustness import distributed as gdist
+from lightgbm_tpu.robustness.chaos import FakeKVStore
+from lightgbm_tpu.robustness.checkpoint import CheckpointError
+from lightgbm_tpu.robustness.checkpoint import main as verify_main
+from lightgbm_tpu.robustness.retry import CommTimeoutError, PeerLostError
+from lightgbm_tpu.robustness.supervisor import FleetSupervisor
+from lightgbm_tpu.robustness.watchdog import EXIT_COMM_LOST
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _payload(it, world=2, tree_learner="data"):
+    return {"iteration": it, "config_fingerprint": "test-gang",
+            "config": {"tree_learner": tree_learner},
+            "state": {"n_devices": 1, "tree_learner": tree_learner},
+            "model": list(range(64))}
+
+
+def _gang(kv, fn, world=2, timeout_ms=30_000, **kw):
+    """Run ``fn(coordinator)`` on one thread per rank; returns rank-ordered
+    results, re-raising the first rank failure."""
+    results, failures = [None] * world, []
+
+    def one(r):
+        try:
+            co = gdist.GangCheckpointCoordinator(
+                kv.directory_for_test, client=kv, rank=r, world=world,
+                timeout_ms=timeout_ms, **kw)
+            results[r] = fn(co)
+        except Exception as e:                               # noqa: BLE001
+            failures.append((r, e))
+
+    ts = [threading.Thread(target=one, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    if failures:
+        raise failures[0][1]
+    return results
+
+
+@pytest.fixture()
+def gang_kv(tmp_path):
+    kv = FakeKVStore(world=2)
+    kv.directory_for_test = str(tmp_path / "gang")
+    return kv
+
+
+# ------------------------------------------------------ gang save + resolve
+
+def test_gang_save_commits_manifest_and_all_shards(gang_kv):
+    paths = _gang(gang_kv, lambda co: co.save(_payload(2)))
+    d = gang_kv.directory_for_test
+    assert sorted(os.path.basename(p) for p in paths) == [
+        "shard_0000000001_r0000.pkl", "shard_0000000001_r0001.pkl"]
+    manifests = gdist.list_manifests(d)
+    assert [e for e, _ in manifests] == [1]
+    man = gdist.load_manifest(manifests[0][1])
+    assert man["world"] == 2 and man["iteration"] == 2
+    assert [s["rank"] for s in man["shards"]] == [0, 1]
+    # the manifest KV key is cleaned up after the commit barrier
+    assert not [k for k in gang_kv.data if "manifest" in k]
+
+
+def test_gang_resolve_picks_newest_common_epoch(gang_kv):
+    def run(co):
+        co.save(_payload(2))
+        co.save(_payload(4))
+        return co.resolve_resume()
+
+    shards = _gang(gang_kv, run)
+    assert [os.path.basename(s) for s in shards] == [
+        "shard_0000000002_r0000.pkl", "shard_0000000002_r0001.pkl"]
+
+
+def test_gang_falls_back_a_full_epoch_together(gang_kv):
+    """A rank that cannot verify the newest epoch drags EVERY rank back to
+    the older one — never a mixed-iteration resume."""
+    _gang(gang_kv, lambda co: (co.save(_payload(2)), co.save(_payload(4))))
+    bad = os.path.join(gang_kv.directory_for_test,
+                       "shard_0000000002_r0001.pkl")
+    raw = bytearray(open(bad, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(bad, "wb").write(bytes(raw))
+    shards = _gang(gang_kv, lambda co: co.resolve_resume())
+    assert [os.path.basename(s) for s in shards] == [
+        "shard_0000000001_r0000.pkl", "shard_0000000001_r0001.pkl"]
+
+
+def test_gang_resolve_refuses_when_nothing_verifies(gang_kv):
+    _gang(gang_kv, lambda co: co.save(_payload(2)))
+    d = gang_kv.directory_for_test
+    for name in os.listdir(d):
+        if name.startswith("shard_"):
+            p = os.path.join(d, name)
+            raw = bytearray(open(p, "rb").read())
+            raw[len(raw) // 2] ^= 0xFF
+            open(p, "wb").write(bytes(raw))
+    with pytest.raises(CheckpointError, match="no epoch verifies"):
+        _gang(gang_kv, lambda co: co.resolve_resume())
+
+
+def test_gang_resolve_fresh_directory_is_none(gang_kv):
+    assert _gang(gang_kv, lambda co: co.resolve_resume()) == [None, None]
+
+
+def test_solo_resume_of_gang_dir_requires_elastic(gang_kv, tmp_path):
+    """A single process (world=1, no client) reading a 2-rank gang dir is
+    an elastic world-size change: loud refusal without elastic=true."""
+    _gang(gang_kv, lambda co: co.save(_payload(2)))
+    d = gang_kv.directory_for_test
+    solo = gdist.GangCheckpointCoordinator(d, client=None, rank=0, world=1)
+    with pytest.raises(LightGBMError, match="[Ee]lastic"):
+        solo.resolve_resume()
+    elastic = gdist.GangCheckpointCoordinator(d, client=None, rank=0,
+                                              world=1, elastic=True)
+    shard = elastic.resolve_resume()
+    assert os.path.basename(shard) == "shard_0000000001_r0000.pkl"
+
+
+def test_gang_save_refuses_mixed_iteration_manifest(gang_kv):
+    with pytest.raises(CheckpointError, match="torn"):
+        _gang(gang_kv, lambda co: co.save(_payload(2 + co.rank)))
+
+
+# -------------------------------------------------- --verify exit codes
+
+def test_verify_cli_exit_2_when_manifest_disagrees_with_shards(gang_kv,
+                                                               capsys):
+    """Satellite: a directory whose ONLY manifest's shard set disagrees
+    (missing/rotted shard) has nothing consistent to resume — exit 2, even
+    though the stray shard files themselves parse."""
+    _gang(gang_kv, lambda co: co.save(_payload(2)))
+    d = gang_kv.directory_for_test
+    os.unlink(os.path.join(d, "shard_0000000001_r0001.pkl"))
+    assert verify_main(["--verify", d]) == 2
+    out = capsys.readouterr()
+    assert "CORRUPT" in out.out
+
+
+def test_verify_cli_exit_1_when_an_older_epoch_still_verifies(gang_kv,
+                                                              capsys):
+    _gang(gang_kv, lambda co: (co.save(_payload(2)), co.save(_payload(4))))
+    d = gang_kv.directory_for_test
+    os.unlink(os.path.join(d, "shard_0000000002_r0001.pkl"))
+    assert verify_main(["--verify", d]) == 1
+    assert "manifest_0000000001" in capsys.readouterr().out
+
+
+def test_verify_cli_exit_0_on_healthy_gang_dir(gang_kv):
+    _gang(gang_kv, lambda co: co.save(_payload(2)))
+    assert verify_main(["--verify", gang_kv.directory_for_test]) == 0
+
+
+# ------------------------------------------------------- resume guards
+
+def _tiny_booster(**over):
+    X = np.random.RandomState(5).rand(400, 5)
+    y = X[:, 0] * 2 + X[:, 1]
+    params = dict(objective="regression", num_leaves=7, min_data_in_leaf=20,
+                  max_bin=31, verbose=-1, seed=11, tree_learner="serial",
+                  **over)
+    bst = lgb.Booster(params=params,
+                      train_set=lgb.Dataset(X, label=y, params=params))
+    bst.update()
+    return bst
+
+
+def test_resume_rejects_tree_learner_change_loudly():
+    """Satellite: swapping tree_learner at the SAME device count is
+    rejected as loudly as the device-count guard — the carried row state
+    is not reinterpretable across strategies."""
+    bst = _tiny_booster()
+    state = bst._gbdt.checkpoint_state()
+    state["tree_learner"] = "data"          # written by a data-parallel run
+    assert state["tree_learner"] != bst._gbdt.pctx.strategy
+    with pytest.raises(LightGBMError, match="tree_learner"):
+        bst._gbdt.restore_checkpoint_state(state)
+
+
+def test_resume_rejects_device_count_change_loudly():
+    bst = _tiny_booster()
+    state = bst._gbdt.checkpoint_state()
+    state["n_devices"] = int(state["n_devices"]) + 7
+    with pytest.raises(LightGBMError, match="device"):
+        bst._gbdt.restore_checkpoint_state(state)
+
+
+# -------------------------------------------------------- heartbeat leases
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _lease_pair(kv, clock, timeout_s=5.0):
+    mk = lambda r: gdist.HeartbeatLease(
+        client=kv, rank=r, world=2, lease_timeout_s=timeout_s,
+        interval_s=0.0, probe_timeout_ms=10, clock=clock)
+    return mk(0), mk(1)
+
+
+def test_lease_expiry_raises_peer_lost_naming_the_rank():
+    kv, clock = FakeKVStore(), FakeClock()
+    me, peer = _lease_pair(kv, clock)
+    me.beat(force=True)
+    peer.beat(force=True)
+    assert me.check_peers() == {1: 0.0}
+    clock.t = 4.0                      # peer beats again inside the lease
+    peer.beat()
+    assert me.check_peers()[1] == 0.0
+    clock.t = 9.5                      # 5.5s since the last advance
+    with pytest.raises(PeerLostError, match="peer rank 1") as ei:
+        me.check_peers()
+    assert ei.value.rank == 1
+
+
+def test_lease_attribution_is_non_raising_and_names_peer():
+    kv, clock = FakeKVStore(), FakeClock()
+    me, peer = _lease_pair(kv, clock)
+    me.beat(force=True)
+    peer.beat(force=True)
+    me.check_peers()
+    clock.t = 11.0
+    att = me.attribution()
+    assert att["peer_lost"] == 1 and att["slowest_rank"] == 1
+    assert att["peer_lease_ages_s"]["1"] == pytest.approx(11.0)
+
+
+def test_lease_beat_is_rate_limited_and_withdraw_deletes():
+    kv, clock = FakeKVStore(), FakeClock()
+    lease = gdist.HeartbeatLease(client=kv, rank=0, world=2,
+                                 lease_timeout_s=5.0, interval_s=2.0,
+                                 clock=clock)
+    assert lease.beat(force=True)
+    assert not lease.beat()            # inside the interval
+    clock.t = 2.5
+    assert lease.beat()
+    lease.withdraw()
+    assert not [k for k in kv.data if "/hb/0" in k]
+
+
+def test_lease_beat_failure_never_raises():
+    class DeadKV(FakeKVStore):
+        def key_value_set_bytes(self, *a, **kw):
+            raise TimeoutError("coordination service down")
+
+    lease = gdist.HeartbeatLease(client=DeadKV(), rank=0, world=2,
+                                 lease_timeout_s=5.0)
+    assert lease.beat(force=True) is False
+
+
+# ------------------------------------------- init retry re-runs the reset
+
+def test_init_retry_reruns_partial_init_reset_between_kv_flaps(monkeypatch):
+    """Satellite: when the KV store flaps on attempt 1, the retry must
+    re-run the jax partial-init reset (shutdown/clear) BEFORE attempt 2 —
+    a bare re-initialize() dies with 'should only be called once'."""
+    import jax
+    events = []
+
+    def flaky_initialize(**kw):
+        events.append("init")
+        if events.count("init") == 1:
+            raise RuntimeError("KV flap: handshake dropped")
+
+    monkeypatch.setattr(comm, "distributed_client", lambda: None)
+    monkeypatch.setattr(jax.distributed, "initialize", flaky_initialize)
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: events.append("reset"))
+    monkeypatch.setenv("LGBM_TPU_COMM_BACKOFF_BASE", "0.01")
+    cfg = Config.from_params(dict(
+        num_machines=2, machines="127.0.0.1:12610,127.0.0.1:12611",
+        local_listen_port=12610, time_out=1))
+    comm.init_distributed(cfg)
+    assert events == ["init", "reset", "init"]
+
+
+def test_init_exhaustion_still_resets_after_last_attempt(monkeypatch):
+    import jax
+    events = []
+
+    def always_down(**kw):
+        events.append("init")
+        raise RuntimeError("ECONNREFUSED")
+
+    monkeypatch.setattr(comm, "distributed_client", lambda: None)
+    monkeypatch.setattr(jax.distributed, "initialize", always_down)
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: events.append("reset"))
+    monkeypatch.setenv("LGBM_TPU_COMM_BACKOFF_BASE", "0.01")
+    cfg = Config.from_params(dict(
+        num_machines=2, machines="127.0.0.1:12610,127.0.0.1:12611",
+        local_listen_port=12611, time_out=1))
+    with pytest.raises(CommTimeoutError):
+        comm.init_distributed(cfg)
+    assert events.count("reset") == events.count("init")
+
+
+# ------------------------------------------------------- fleet supervisor
+
+class FakeProc:
+    """poll() walks a plan: None entries = still running, the final int =
+    exit code. terminate()/kill() finish an unfinished plan with -15/-9."""
+
+    def __init__(self, plan):
+        self._plan = iter(plan)
+        self._rc = None
+
+    def poll(self):
+        if self._rc is None:
+            try:
+                nxt = next(self._plan)
+            except StopIteration:
+                nxt = None
+            self._rc = nxt
+        return self._rc
+
+    def terminate(self):
+        if self._rc is None:
+            self._rc = -15
+
+    def kill(self):
+        if self._rc is None:
+            self._rc = -9
+
+    def wait(self, timeout=None):
+        return self.poll()
+
+
+class PlanSpawner:
+    """spawn_fn double: generation g's rank r gets FakeProc(plans[g][r]);
+    records every argv materialized for it."""
+
+    def __init__(self, plans):
+        self.plans = plans
+        self.argvs = []
+        self._gen, self._it = -1, None
+
+    def __call__(self, argv):
+        self.argvs.append(list(argv))
+        if self._it is None:
+            self._gen += 1
+            self._it = iter([FakeProc(p) for p in self.plans[self._gen]])
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._gen += 1
+            self._it = iter([FakeProc(p) for p in self.plans[self._gen]])
+            return next(self._it)
+
+
+def _fleet(plans, world=2, **kw):
+    ticks = itertools.count()
+    sp = PlanSpawner(plans)
+    fs = FleetSupervisor(["checkpoint_dir="], world, seed=1,
+                         backoff_base_s=0.0, backoff_max_s=0.0, jitter=0.0,
+                         spawn_fn=sp, sleep=lambda s: None,
+                         clock=lambda: next(ticks) * 0.1, **kw)
+    return fs, sp
+
+
+def test_fleet_kill9_attribution_and_relaunch():
+    """Rank 1 dies -9; rank 0 self-exits 145 within the reap grace (the
+    survivor's own code IS the attribution) — only rank 1 is the culprit,
+    and the relaunched gang finishes clean."""
+    fs, sp = _fleet([
+        [[None, None, 145], [None, -9]],   # gen 0
+        [[None, 0], [0]],                  # gen 1: clean
+    ], max_restarts=3)
+    assert fs.run() == 0
+    assert fs.restarts == 1
+    assert fs.gang_exit_codes == [{0: 145, 1: -9}]
+    assert fs._consecutive_fails.get(1, 0) == 1
+    assert fs._consecutive_fails.get(0, 0) == 0
+    # every relaunch carries resume_from=auto exactly once
+    for argv in sp.argvs:
+        assert argv.count("resume_from=auto") == 1
+
+
+def test_fleet_refuses_shrink_without_elastic():
+    fs, _ = _fleet([
+        [[None, 145], [-9]],
+        [[None, 145], [-9]],
+    ], max_restarts=5, rank_dead_after=2)
+    assert fs.run() == EXIT_COMM_LOST
+    assert fs.world == 2 and fs.shrinks == 0
+
+
+def test_fleet_elastic_shrink_appends_reshard_tokens():
+    fs, sp = _fleet([
+        [[None, 145], [-9]],
+        [[None, 145], [-9]],
+        [[0]],                             # shrunk world=1, clean
+    ], max_restarts=5, rank_dead_after=2, elastic=True)
+    assert fs.run() == 0
+    assert fs.world == 1 and fs.shrinks == 1
+    assert "elastic=true" in fs._appended
+    assert "tpu_reshard_on_resume=true" in fs._appended
+    last_gen_argv = sp.argvs[-1]
+    assert "elastic=true" in last_gen_argv
+    assert "world=1" not in last_gen_argv   # template had no {world} token
+
+
+def test_fleet_restart_budget_returns_worst_code():
+    fs, _ = _fleet([
+        [[7], [0]],
+        [[7], [0]],
+    ], max_restarts=1, rank_dead_after=5)
+    assert fs.run() == 7
+
+
+def test_fleet_mttr_measured_from_new_manifest(tmp_path):
+    """Fleet MTTR: failure time -> first NEW gang epoch after relaunch."""
+    d = str(tmp_path / "ck")
+    kv = FakeKVStore(world=2)
+    kv.directory_for_test = d
+    _gang(kv, lambda co: co.save(_payload(2)))        # epoch 1 pre-exists
+
+    banked = []
+
+    class BankingSpawner(PlanSpawner):
+        def __call__(self, argv):
+            if len(self.argvs) == 2 and not banked:
+                # first spawn of the relaunched generation banks a NEW epoch
+                solo = gdist.GangCheckpointCoordinator(
+                    d, client=None, rank=0, world=1)
+                solo.save(_payload(4))
+                banked.append(True)
+            return super().__call__(argv)
+
+    ticks = itertools.count()
+    sp = BankingSpawner([
+        [[None, -9], [None, 145]],
+        [[None, None, None, 0], [None, None, None, 0]],
+    ])
+    fs = FleetSupervisor([f"checkpoint_dir={d}"], 2, seed=1,
+                         backoff_base_s=0.0, backoff_max_s=0.0, jitter=0.0,
+                         spawn_fn=sp, sleep=lambda s: None,
+                         clock=lambda: next(ticks) * 0.1, max_restarts=3)
+    assert fs.run() == 0
+    assert len(fs.recovery_seconds) == 1
+    assert fs.recovery_seconds[0] > 0
+
+
+# ----------------------------------------------------------- gang_env hook
+
+def test_gang_env_override_roundtrip():
+    kv = FakeKVStore()
+    gdist.install_gang_override(kv, rank=1, world=4)
+    try:
+        client, rank, world = gdist.gang_env()
+        assert (rank, world) == (1, 4)
+        assert client is kv
+    finally:
+        gdist.uninstall_gang_override()
+    assert gdist.gang_env() is None

@@ -42,6 +42,7 @@ BAD_FIXTURES = [
     (os.path.join("lightgbm_tpu", "bad_r010.py"), "R010"),
     (os.path.join("lightgbm_tpu", "serving", "bad_r011.py"), "R011"),
     (os.path.join("lightgbm_tpu", "bad_r012.py"), "R012"),
+    (os.path.join("lightgbm_tpu", "bad_r013.py"), "R013"),
 ]
 
 
