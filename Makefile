@@ -42,6 +42,7 @@ verify: lint
 	env JAX_PLATFORMS=cpu $(PYTEST) tests/ -m 'not slow'
 	python bench.py --smoke
 	$(MAKE) stream
+	$(MAKE) ingest
 	$(MAKE) linear
 	$(MAKE) serve
 	$(MAKE) serve-chaos
@@ -58,6 +59,18 @@ verify: lint
 stream:
 	env LGBM_TPU_STREAM_ROWS=20000 LGBM_TPU_STREAM_ITERS=5 \
 	    python bench.py --stream
+
+# Device-side ingest phase (docs/TPU-Performance.md "Device-side ingest"):
+# hermetic-CPU raw-rows-to-codes A/B — the jitted chunked bin+pack kernel
+# (tpu_ingest=device, ops/ingest.py) vs the host bin_dense_host oracle.
+# Asserts BIT identity (real region, padding zeros, packed bytes), one
+# compile for every chunk shape class, a >= 3x device rows/s floor, and
+# measures the prefetch overlap vs a forced no-prefetch arm. Bank with
+# LGBM_TPU_INGEST_OUT=INGEST_r<N>.json; `bench.py --compare` judges the
+# newest banked file under the |ingest= comparability key. Bigger N:
+# LGBM_TPU_INGEST_ROWS=2000000 make ingest.
+ingest:
+	env LGBM_TPU_INGEST_ROWS=200000 python bench.py --ingest
 
 # Wide-sparse (Bosch-shaped) EFB phase, three arms: bundlespace (native
 # bundle-space scan/routing — the default), efb_unpack (legacy
@@ -188,4 +201,4 @@ trace:
 
 .PHONY: lint verify check-fast check capi bench-cpu chaos bench-chaos \
         chaos-dist trace bench-diff ledger multichip stream serve \
-        serve-chaos sparse linear
+        serve-chaos sparse linear ingest

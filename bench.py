@@ -1519,6 +1519,56 @@ def run_smoke():
     except Exception as e:            # noqa: BLE001 — any failure fails CI
         lin_ok, lin_err = False, f"{type(e).__name__}: {e}"
 
+    # ---- device-ingest guarded loop (ops/ingest.py) ------------------------
+    # The same smoke dataset built from RAW rows under tpu_ingest=device
+    # (explicit device skips the 65536-row auto threshold): the jitted bin
+    # kernel must compile exactly ONCE across all chunks including the
+    # zero-masked tail, the placed code matrix must equal the headline
+    # (host-binned) booster's bit-for-bit, the training loop must stay
+    # 0-recompile under the guard, and predictions must match the headline
+    # run exactly — end-to-end training from raw arrays is bit-identical
+    # to the host-binned path.
+    ing_ok, ing_err = True, None
+    ing_misses, ing_compiles = -1, None
+    try:
+        params_i = dict(params, tpu_ingest="device")
+        ds_i = lgb.Dataset(X, label=y, params=params_i)
+        bst_i = lgb.Booster(params=params_i, train_set=ds_i)
+        g_i = bst_i._gbdt
+        if g_i._ingest_report is None:
+            raise RuntimeError("device ingest did not engage under "
+                               "tpu_ingest=device")
+        ing_compiles = g_i._ingest_report.get("compiles")
+        if ing_compiles != 1:
+            raise RuntimeError(f"ingest bin kernel compiled "
+                               f"{ing_compiles}x, expected exactly 1")
+        if not np.array_equal(np.asarray(bst._gbdt.Xb), np.asarray(g_i.Xb)):
+            raise RuntimeError("device-ingested code matrix differs from "
+                               "the host-binned placement")
+        for _ in range(2):
+            bst_i.update()
+        np.asarray(g_i.score).sum()
+        guard_i = RecompileGuard(label="smoke-ingest")
+        guard_i.register(g_i._step_fn, "train_step")
+        with guard_i:
+            guard_i.mark_warm()
+            for _ in range(iters):
+                bst_i.update()
+            np.asarray(g_i.score).sum()
+        rep_i = guard_i.report()
+        ing_misses = rep_i["post_warmup_cache_misses"]
+        if ing_misses:
+            raise RuntimeError(
+                f"device-ingest booster recompiled: {ing_misses} "
+                f"post-warm-up cache miss(es)")
+        if not np.array_equal(bst.predict(X), bst_i.predict(X)):
+            raise RuntimeError("device-ingest predictions differ from the "
+                               "host-binned run")
+    except GuardViolation as e:
+        ing_ok, ing_err = False, str(e)
+    except Exception as e:            # noqa: BLE001 — any failure fails CI
+        ing_ok, ing_err = False, f"{type(e).__name__}: {e}"
+
     # ---- trace-lint interference (analysis/trace_lint.py) ------------------
     # `make lint`'s trace tier traces and lowers the SHIPPED entry points
     # (contracts T001+, docs/Static-Analysis.md "Trace contracts"). Running
@@ -1614,12 +1664,16 @@ def run_smoke():
            "linear_ok": lin_ok,
            "linear_post_warmup_cache_misses": lin_misses,
            "linear_host_syncs": lin_syncs,
+           "ingest_ok": ing_ok,
+           "ingest_post_warmup_cache_misses": ing_misses,
+           "ingest_compiles": ing_compiles,
            "trace_lint_ok": trace_ok,
            "trace_lint_cells": trace_cells,
            "trace_lint_cells_skipped": trace_skipped,
            "trace_lint_post_warmup_cache_misses": trace_misses,
            "ok": (ok and resume_ok and cache_ok and tel_ok and cost_ok
-                  and rob_ok and efb_ok and lin_ok and trace_ok)}
+                  and rob_ok and efb_ok and lin_ok and ing_ok
+                  and trace_ok)}
     if err:
         out["error"] = err[:300]
     if resume_err:
@@ -1636,6 +1690,8 @@ def run_smoke():
         out["efb_error"] = efb_err[:300]
     if lin_err:
         out["linear_error"] = lin_err[:300]
+    if ing_err:
+        out["ingest_error"] = ing_err[:300]
     if trace_err:
         out["trace_lint_error"] = trace_err[:300]
     print(json.dumps(out))
@@ -1977,6 +2033,165 @@ def run_stream(argv=None):
     if out_path:
         # the one atomic JSON writer (observability/export.py, pid-suffixed
         # tmp — concurrent runs never clobber each other's in-flight file)
+        from lightgbm_tpu.observability.export import atomic_write_json
+        atomic_write_json(out_path, out)
+    return 0 if ok else 1
+
+
+# ------------------------------------------------------------ ingest phase
+
+def run_ingest(argv=None):
+    """`bench.py --ingest`: the device-side dataset ingest phase
+    (tpu_ingest=device, ops/ingest.py; docs/TPU-Performance.md
+    "Device-side ingest"). Hermetic CPU, like --smoke. What it proves:
+
+    1. BIT IDENTITY — the device-binned code matrix (real region, the
+       row/column padding zeros, AND the packed byte layout) equals the
+       host oracle (dataset.bin_dense_host + np.pad + pack_codes_host)
+       exactly. Identity is a hard gate, not a tolerance band.
+    2. THROUGHPUT — steady-state device ingest (H2D feed + jitted bin +
+       pack, stall-accounted) runs >= 3x the host oracle's rows/s; the
+       one-off compile pass is reported separately as device_cold_s.
+    3. 0-RECOMPILE — every chunk, including the zero-masked tail, reuses
+       the first chunk's executable (traced row offset; RecompileGuard
+       over the jitted bin kernel).
+    4. MEASURED OVERLAP — the prefetch stall fraction plus a forced
+       no-prefetch arm (LGBM_TPU_INGEST_NO_PREFETCH) so the double
+       buffer's win is a measured delta, not an assumption.
+
+    Prints ONE JSON line (bench schema + ingest extras; ingest=device
+    keys it into its own perf-ledger comparability class). Exit 0 iff
+    identity + guard + floor hold. LGBM_TPU_INGEST_OUT writes the same
+    payload to a file for banking as INGEST_r<N>.json."""
+    from lightgbm_tpu.utils.hermetic import force_cpu_backend
+    force_cpu_backend()
+    import time
+
+    from lightgbm_tpu.analysis.guards import GuardViolation, RecompileGuard
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import bin_dense_host, construct_dataset
+    from lightgbm_tpu.ops import ingest as ingest_mod
+    from lightgbm_tpu.ops.histogram import code_mode_for
+    from lightgbm_tpu.ops.stream import pack_codes_host
+
+    n_rows = int(os.environ.get("LGBM_TPU_INGEST_ROWS", "200000"))
+    X, y = _higgs_like(n_rows)
+    rng = np.random.RandomState(7)
+    X[rng.rand(n_rows) < 0.05, 3] = np.nan      # exercise the NaN-bin path
+    cfg = Config.from_params({"max_bin": 255, "verbose": -1,
+                              "tpu_ingest": "host"})
+    cd = construct_dataset(X, y, cfg)
+    mappers = cd.mappers
+    real_idx = np.asarray(cd.real_feature_idx)
+    dtype = cd.code_dtype
+    F = len(real_idx)
+    # residency-style padding: an extra row block beyond the 256-multiple
+    # (a whole zero-masked tail chunk region) and +4 feature columns — the
+    # identity check covers the padding zeros, not just the real region
+    n_pad = ((n_rows + 255) // 256) * 256 + 256
+    cols_pad = F + 4
+
+    out = {"metric": "ingest_throughput", "unit": "Mrow/s",
+           "platform": "cpu", "rows": n_rows, "num_cols": cols_pad,
+           "kernel": "xla", "n_devices": 1, "ingest": "device",
+           "max_bin": 255}
+    ok, err = True, []
+
+    # ---- host oracle arm (the single-pass bin_dense_host) ------------------
+    bin_dense_host(X, mappers, real_idx, dtype, n_rows)     # warm caches
+    t0 = time.perf_counter()
+    Xb_host = bin_dense_host(X, mappers, real_idx, dtype, n_rows)
+    t_host = time.perf_counter() - t0
+    host_mrow = n_rows / t_host / 1e6
+    out["host_mrow_per_s"] = _round_tp(host_mrow)
+    ref = np.zeros((n_pad, cols_pad), dtype)
+    ref[:n_rows, :F] = Xb_host
+
+    # ---- device arm: cold (compile) pass, then steady under the guard ------
+    ing = ingest_mod.DeviceIngestor(mappers, num_cols=cols_pad,
+                                    n_rows=n_rows, out_dtype=dtype)
+    kw = dict(n_rows=n_rows, n_rows_padded=n_pad, num_cols=cols_pad,
+              out_dtype=dtype, ingestor=ing)
+    t0 = time.perf_counter()
+    codes, _rep_cold = ingest_mod.device_ingest(X, mappers, real_idx, **kw)
+    out["device_cold_s"] = round(time.perf_counter() - t0, 4)
+    guard = RecompileGuard(label="ingest")
+    guard.register(ing._fn, "ingest_bin")
+    try:
+        with guard:
+            guard.mark_warm()
+            t0 = time.perf_counter()
+            codes, rep = ingest_mod.device_ingest(X, mappers, real_idx, **kw)
+            t_dev = time.perf_counter() - t0
+    except GuardViolation as e:
+        ok = False
+        err.append(str(e)[:300])
+        t_dev, rep = float("nan"), _rep_cold
+    out["recompiles_post_warmup"] = guard.report()["post_warmup_cache_misses"]
+    finite = t_dev > 0                # False for nan
+    dev_mrow = n_rows / t_dev / 1e6 if finite else None
+    out["value"] = _round_tp(dev_mrow) if finite else None
+    out["device_vs_host"] = _round_ratio(dev_mrow / host_mrow) \
+        if finite else None
+    out["compiles"] = ing.compiles
+    out["chunks"] = rep["n_chunks"]
+    out["chunk_rows"] = rep["chunk_rows"]
+    out["bytes_h2d"] = rep["bytes_h2d"]
+    out["prefetch_stalls"] = rep["stalls"]
+    out["prefetch_stall_fraction"] = round(rep["stall_fraction"], 4) \
+        if finite else None
+
+    # ---- bit identity: real region + padding zeros + packed layout ---------
+    ident = bool(np.array_equal(np.asarray(codes), ref))
+    mode = code_mode_for(int(Xb_host.max()), dtype)
+    out["packed_mode"] = mode
+    ing_p = ingest_mod.DeviceIngestor(mappers, num_cols=cols_pad,
+                                      n_rows=n_rows, out_dtype=dtype,
+                                      code_mode=mode)
+    packed_dev, _ = ingest_mod.device_ingest(
+        X, mappers, real_idx, n_rows=n_rows, n_rows_padded=n_pad,
+        num_cols=cols_pad, out_dtype=dtype, code_mode=mode, ingestor=ing_p)
+    ident_packed = bool(np.array_equal(np.asarray(packed_dev),
+                                       pack_codes_host(ref, mode)))
+    out["identical_to_host"] = ident and ident_packed
+    if not ident:
+        ok = False
+        err.append("device codes differ from the host oracle")
+    if not ident_packed:
+        ok = False
+        err.append(f"device {mode}-packed bytes differ from pack_codes_host")
+
+    # ---- forced no-prefetch arm: the overlap, measured ---------------------
+    os.environ["LGBM_TPU_INGEST_NO_PREFETCH"] = "1"
+    try:
+        t0 = time.perf_counter()
+        _codes_np, rep_np = ingest_mod.device_ingest(X, mappers, real_idx,
+                                                     **kw)
+        t_np = time.perf_counter() - t0
+        out["no_prefetch_mrow_per_s"] = _round_tp(n_rows / t_np / 1e6)
+        out["overlap_speedup_vs_no_prefetch"] = _round_ratio(t_np / t_dev) \
+            if finite else None
+        out["no_prefetch_stall_fraction"] = round(rep_np["stall_fraction"], 4)
+    finally:
+        os.environ.pop("LGBM_TPU_INGEST_NO_PREFETCH", None)
+
+    # ---- gates -------------------------------------------------------------
+    if out["recompiles_post_warmup"]:
+        ok = False
+        err.append(f"{out['recompiles_post_warmup']} post-warm-up ingest "
+                   f"recompile(s) — the traced row offset leaked a static")
+    if finite and out["device_vs_host"] is not None \
+            and out["device_vs_host"] < 3.0:
+        ok = False
+        err.append(f"device ingest only {out['device_vs_host']}x the host "
+                   f"oracle — below the 3x acceptance floor")
+
+    out["ok"] = ok
+    if err:
+        out["error"] = "; ".join(err)[:500]
+    print(json.dumps(out))
+    out_path = os.environ.get("LGBM_TPU_INGEST_OUT", "")
+    if out_path:
         from lightgbm_tpu.observability.export import atomic_write_json
         atomic_write_json(out_path, out)
     return 0 if ok else 1
@@ -3466,6 +3681,26 @@ def run_compare(argv):
                              "problems": lp, "notes": lnn, "ok": not lp}
             problems = problems + lp
             break
+        # ... and the newest banked INGEST result (bench.py --ingest): the
+        # |ingest= comparability key means the device-binning rows/s floor
+        # only judges ingest history, and the bit-identity flag is a hard
+        # gate — a device binning that drifts from the host oracle by one
+        # code fails make bench-diff regardless of throughput
+        for p in reversed(sorted(
+                _glob.glob(os.path.join(repo, "INGEST_r*.json")))):
+            pl = perf_ledger.payload_of(p)
+            if not pl or pl.get("metric") != "ingest_throughput":
+                continue
+            ip, inn = perf_ledger.compare(
+                pl, entries, exclude_source=os.path.basename(p))
+            out["ingest"] = {"candidate": os.path.basename(p),
+                             "value": pl.get("value"),
+                             "device_vs_host": pl.get("device_vs_host"),
+                             "identical_to_host":
+                                 pl.get("identical_to_host"),
+                             "problems": ip, "notes": inn, "ok": not ip}
+            problems = problems + ip
+            break
         # ... and the newest banked SERVE_CHAOS result (bench.py
         # --serve-chaos): the |serve_chaos= comparability key gates the
         # shed-rate ceiling and p99-under-overload, so a serving-
@@ -3519,6 +3754,8 @@ if __name__ == "__main__":
         sys.exit(run_smoke())
     elif "--stream" in sys.argv:
         sys.exit(run_stream(sys.argv))
+    elif "--ingest" in sys.argv:
+        sys.exit(run_ingest(sys.argv))
     elif "--linear" in sys.argv:
         sys.exit(run_linear(sys.argv))
     elif "--serve-chaos" in sys.argv:

@@ -213,7 +213,12 @@ class BinMapper:
                                                 min_split_data, self.bin_type):
             self.is_trivial = True
         if not self.is_trivial:
+            # the ONE sanctioned zero-bin computation: every consumer
+            # (dataset binning loops, bin_raw, EFB, ingest tables) reads
+            # .default_bin instead of re-running value_to_bin(0) per
+            # column; agreement is asserted here, once, at construction
             self.default_bin = int(self.value_to_bin(np.array([0.0]))[0])
+            assert self.default_bin == int(self.value_to_bin(np.zeros(1))[0])
             if self.bin_type == BIN_CATEGORICAL:
                 assert self.default_bin > 0
         denom = max(total_sample_cnt, 1)
@@ -319,28 +324,40 @@ class BinMapper:
 
     # -- application ---------------------------------------------------------
 
-    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
-        """Vectorized ValueToBin (bin.h:451-487)."""
+    def value_to_bin(self, values: np.ndarray,
+                     out: Optional[np.ndarray] = None) -> np.ndarray:
+        """Vectorized ValueToBin (bin.h:451-487).
+
+        ``out`` writes the codes straight into a preexisting array (any
+        integer dtype, unsafe cast) — the dataset binning loop fills
+        ``X_binned`` columns in a single pass with no int32 intermediate
+        plus ``astype`` plus assignment-copy chain. This host path is the
+        ORACLE the device ingest kernel (ops/ingest.py) is tested against
+        bit-for-bit."""
         values = np.asarray(values, dtype=np.float64)
         if self.bin_type == BIN_NUMERICAL:
             nan_mask = np.isnan(values)
-            search_vals = np.where(nan_mask, 0.0, values)
+            has_nan = bool(nan_mask.any())
+            search_vals = np.where(nan_mask, 0.0, values) if has_nan else values
             ub = self.bin_upper_bound
             r = self.num_bin - 1
             if self.missing_type == MISSING_NAN:
                 r -= 1  # NaN bin excluded from the search range (bin.h:463-465)
             bins = np.searchsorted(ub[: r + 1], search_vals, side="left")
-            bins = np.minimum(bins, r)
-            if self.missing_type == MISSING_NAN:
-                bins = np.where(nan_mask, self.num_bin - 1, bins)
-            return bins.astype(np.int32)
-        # categorical: negative / unseen -> last bin (bin.h:476-486)
-        out = np.full(values.shape, self.num_bin - 1, dtype=np.int32)
-        int_vals = np.where(np.isnan(values), -1, values).astype(np.int64)
-        for cat, b in self.categorical_2_bin.items():
-            out[int_vals == cat] = b
-        out[int_vals < 0] = self.num_bin - 1
-        return out
+            np.minimum(bins, r, out=bins)
+            if has_nan and self.missing_type == MISSING_NAN:
+                np.copyto(bins, self.num_bin - 1, where=nan_mask)
+        else:
+            # categorical: negative / unseen -> last bin (bin.h:476-486)
+            bins = np.full(values.shape, self.num_bin - 1, dtype=np.int32)
+            int_vals = np.where(np.isnan(values), -1, values).astype(np.int64)
+            for cat, b in self.categorical_2_bin.items():
+                bins[int_vals == cat] = b
+            bins[int_vals < 0] = self.num_bin - 1
+        if out is not None:
+            np.copyto(out, bins, casting="unsafe")
+            return out
+        return bins.astype(np.int32, copy=False)
 
     def bin_to_value(self, bin_idx: int) -> float:
         """Representative value for a bin (used in model export thresholds)."""

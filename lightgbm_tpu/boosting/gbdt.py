@@ -219,9 +219,11 @@ class GBDT:
             self._efb_unpack = True
             _efb_unpack_forced = True
         if config.enable_bundle != "false" and F >= 2:
-            from ..efb import _SAMPLE_ROWS, plan_bundles, sample_rows
+            from ..efb import (_SAMPLE_ROWS, plan_bundles,
+                               sample_row_indices, sample_rows)
             efb_sample = None
             efb_ndata = None
+            X_for_plan = None
             if self._block_counts is not None:
                 from ..parallel.comm import host_allgather
                 per_rank = max(1, _SAMPLE_ROWS // len(self._block_counts))
@@ -229,7 +231,18 @@ class GBDT:
                     sample_rows(train_set.X_binned, per_rank), "efb_sample")
                 efb_sample = np.concatenate(parts, axis=0)
                 efb_ndata = N
-            plan = plan_bundles(train_set.X_binned,
+                X_for_plan = train_set.X_binned
+            elif train_set.deferred:
+                # deferred device ingest: plan from a host-binned row
+                # SAMPLE (the plan is a pure function of the sample, and
+                # bin_rows draws the exact rows sample_rows would) — the
+                # full host bin matrix is only materialized below if the
+                # plan actually wins
+                efb_sample = train_set.bin_rows(sample_row_indices(N))
+                efb_ndata = N
+            else:
+                X_for_plan = train_set.X_binned
+            plan = plan_bundles(X_for_plan,
                                 meta["num_bins"].astype(np.int64),
                                 meta["default_bin"].astype(np.int64), config,
                                 sample=efb_sample, num_data=efb_ndata)
@@ -260,6 +273,15 @@ class GBDT:
                         plan.num_groups * Bb_pad, F * Bpad)
                 if wins or config.enable_bundle == "true":
                     bundle_plan = plan
+                    if plan.X_bundled is None:
+                        # the plan won under deferred ingest: bundling
+                        # needs the host bin matrix after all — pay the
+                        # host materialization now (device ingest serves
+                        # the unbundled layout only)
+                        from ..efb import materialize_bundles
+                        plan.X_bundled = materialize_bundles(
+                            plan, train_set.X_binned,
+                            meta["default_bin"].astype(np.int64))
                     if _efb_unpack_forced:
                         Log.warning(
                             "tree_learner=voting with categorical features "
@@ -328,7 +350,7 @@ class GBDT:
         from ..utils.cache import pallas_config_key, pallas_validated_on_chip
         _kernel_dtype = (bundle_plan.X_bundled.dtype
                          if bundle_plan is not None
-                         else train_set.X_binned.dtype)
+                         else train_set.code_dtype)
         _kernel_bins = Bb_pad if bundle_plan is not None else Bpad
         pallas_shape_key = pallas_config_key(
             int(np.dtype(_kernel_dtype).itemsize), _kernel_bins,
@@ -432,8 +454,20 @@ class GBDT:
                 code_feat=self._put(cf))
             self._hist_bins = Bb_pad
         else:
-            Xb = train_set.X_binned
             self._hist_bins = 0
+            if (train_set.deferred and self.residency != "stream"
+                    and self._block_counts is None
+                    and not self.pctx.multi_process):
+                # device ingest engages: raw rows bin+pack on device in
+                # the placement build below — host X_binned never exists
+                Xb = None
+            else:
+                if train_set.deferred:
+                    Log.info(
+                        "deferred ingest falls back to host binning (%s)",
+                        "stream residency" if self.residency == "stream"
+                        else "pre-partitioned/multi-process layout")
+                Xb = train_set.X_binned
         # dataset fingerprint for checkpoint/resume: the config fingerprint
         # deliberately excludes data PATHS, so a resumed run pointed at a
         # different dataset of the same shape must be caught here — a strided
@@ -441,9 +475,21 @@ class GBDT:
         # both are still host arrays (no device fetch, computed once)
         import hashlib
         _fp = hashlib.sha256()
-        _fp.update(np.int64([N, Xb.shape[0], Xb.shape[1]]).tobytes())
-        _stride = max(1, Xb.shape[0] // 256)
-        _fp.update(np.ascontiguousarray(Xb[::_stride]).tobytes())
+        if Xb is None:
+            # deferred device ingest: hash the SAME strided row sample the
+            # host path would, binned through the host oracle (bin_rows is
+            # byte-identical to X_binned[::stride]) — the fingerprint is
+            # invariant to WHERE binning runs, so tpu_ingest stays a
+            # checkpoint-VOLATILE knob
+            _shape0, _shape1 = train_set.num_data, train_set.num_features
+            _fp.update(np.int64([N, _shape0, _shape1]).tobytes())
+            _stride = max(1, _shape0 // 256)
+            _fp.update(train_set.bin_rows(
+                np.arange(0, _shape0, _stride)).tobytes())
+        else:
+            _fp.update(np.int64([N, Xb.shape[0], Xb.shape[1]]).tobytes())
+            _stride = max(1, Xb.shape[0] // 256)
+            _fp.update(np.ascontiguousarray(Xb[::_stride]).tobytes())
         _fp.update(np.asarray(meta_global.label, np.float32).tobytes())
         self._data_fingerprint = _fp.hexdigest()
 
@@ -455,11 +501,13 @@ class GBDT:
         # mask are immutable step CONSTANTS, so every booster built over the
         # same mesh/padding reuses the same on-device buffers — the binned
         # dataset lives on the mesh once, not once per booster.
-        col_pad = (0, cols_pad - Xb.shape[1])
+        _ncols = Xb.shape[1] if Xb is not None else train_set.num_features
+        col_pad = (0, cols_pad - _ncols)
         self._stream_store = None
         self._stream = None
         self._streamed_grower = None
         self._stream_fns = None
+        self._ingest_report = None
         if self.residency == "stream":
             # out-of-core: the padded (possibly bundled) code matrix is cut
             # into fixed-size host shards, packed to the tightest byte
@@ -519,11 +567,19 @@ class GBDT:
                     int(bundle_plan.max_bundle_bins),
                     zlib.crc32(np.ascontiguousarray(bundle_plan.col).tobytes()),
                     zlib.crc32(np.ascontiguousarray(bundle_plan.off).tobytes()))
+            # the cache key is IDENTICAL for host and device ingest — both
+            # produce bit-identical placed codes, so a booster switching
+            # tpu_ingest reuses the same on-device buffers
+            _code_dtype = Xb.dtype if Xb is not None else train_set.code_dtype
+            if Xb is None:
+                _build = lambda: self._ingest_device(  # noqa: E731
+                    train_set, N, Npad, cols_pad)
+            else:
+                _build = lambda: self._put(  # noqa: E731
+                    np.pad(Xb, ((0, Npad - N), col_pad)), "rows0")
             self.Xb = train_set.device_put_cached(
-                ("Xb", Npad, cols_pad, str(Xb.dtype), bundle_sig,
-                 self.pctx.residency_key()),
-                lambda: self._put(np.pad(Xb, ((0, Npad - N), col_pad)),
-                                  "rows0"))
+                ("Xb", Npad, cols_pad, str(_code_dtype), bundle_sig,
+                 self.pctx.residency_key()), _build)
         self.label = self._put(self._row_layout(meta_global.label, Npad), "rows")
         w = meta_global.weight
         self.weight = None if w is None else self._put(
@@ -555,10 +611,11 @@ class GBDT:
         from ..ops.histogram import code_mode_for, default_code_mode
         max_code = (bundle_plan.max_bundle_bins if bundle_plan is not None
                     else train_set.max_num_bin)
+        _xb_dtype = Xb.dtype if Xb is not None else train_set.code_dtype
         if hist_kernel in ("pallas", "mixed"):
-            code_mode = default_code_mode(Xb.dtype)
+            code_mode = default_code_mode(_xb_dtype)
         else:
-            code_mode = code_mode_for(int(max_code), Xb.dtype)
+            code_mode = code_mode_for(int(max_code), _xb_dtype)
 
         # explicit pallas/mixed on real hardware: consult the per-shape-class
         # on-chip trust record (utils/cache.pallas_validated_on_chip). An
@@ -1026,6 +1083,33 @@ class GBDT:
             return jax.make_array_from_callback(x.shape, sharding,
                                                 lambda idx: x[idx])
         return jax.device_put(jnp.asarray(x), sharding)
+
+    def _ingest_device(self, train_set, N: int, Npad: int, cols_pad: int):
+        """Bin + pack the deferred raw rows on device (ops/ingest.py) —
+        the build closure of the Xb residency cache when device ingest
+        engages. Bit-identical to host binning + ``np.pad`` + ``_put``
+        (tests/test_ingest.py); multi-device layouts reshard the
+        device-0 result through the mesh row sharding (a device-to-device
+        move, not a second host upload)."""
+        from ..ops.ingest import device_ingest
+        cfg = self.config
+        arr, report = device_ingest(
+            train_set.deferred_raw(), train_set.mappers,
+            np.asarray(train_set.real_feature_idx),
+            n_rows=N, n_rows_padded=Npad, num_cols=cols_pad,
+            out_dtype=train_set.code_dtype,
+            chunk_rows=int(cfg.tpu_ingest_chunk_rows),
+            device=self.pctx.devices[0],
+            prefetch_depth=int(cfg.tpu_ingest_prefetch))
+        self._ingest_report = report
+        Log.info("device ingest: %d rows binned+packed on device "
+                 "(%.2f Mrow/s, %d chunks, stall fraction %.2f)",
+                 N, (report["rows_per_s"] or 0.0) / 1e6, report["n_chunks"],
+                 report["stall_fraction"])
+        sharding = self.pctx.sharding("rows0")
+        if sharding is not None:
+            arr = jax.device_put(arr, sharding)
+        return arr
 
     def add_valid(self, name: str, binned: np.ndarray, metadata: Metadata,
                   raw: Optional[np.ndarray] = None) -> None:

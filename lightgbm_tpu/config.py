@@ -374,6 +374,26 @@ class Config:
     # size never changes the math, so any value resumes any checkpoint);
     # 0 = auto (~8 shards)
     tpu_stream_shard_rows: int = 0
+    # --- device-side ingest (ops/ingest.py, docs/TPU-Performance.md) -------
+    # where raw float rows are BINNED into the packed code matrix:
+    #   host   — the classical path: BinMapper.value_to_bin column loop on
+    #            host, then one bulk H2D placement
+    #   device — defer binning: raw f32 chunks stream H2D double-buffered
+    #            and a jit kernel bins + packs in-trace, writing straight
+    #            into the sharded residency buffers. BIT-identical to host
+    #            binning (tests/test_ingest.py pins it) or it falls back
+    #            with a logged reason (f32-lossy f64 input, sparse,
+    #            oversized categoricals, stream residency, multi-process)
+    #   auto   — device iff eligible AND num_data is large enough for the
+    #            deferral to pay (dataset._AUTO_DEFER_MIN_ROWS)
+    # checkpoint-VOLATILE: it changes WHERE binning runs, never the codes
+    tpu_ingest: str = "auto"
+    # raw rows per ingest chunk; 0 = auto (~64 MiB of f32 chunk + threshold
+    # working set, clamped to [4096, 131072], rounded to a multiple of 256)
+    tpu_ingest_chunk_rows: int = 0
+    # ingest H2D prefetch depth (chunks in flight ahead of the bin kernel);
+    # 0 disables overlap — the stall-accounting A/B arm of bench --ingest
+    tpu_ingest_prefetch: int = 1
     # artificial per-device HBM budget in bytes for the residency auto-
     # decision and the engine.train budget line; 0 = use the capacity the
     # backend reports (env LGBM_TPU_HBM_BUDGET overrides both)
@@ -591,6 +611,15 @@ class Config:
         if self.tpu_stream_shard_rows < 0:
             Log.fatal("tpu_stream_shard_rows must be >= 0 (0 = auto), got %d",
                       self.tpu_stream_shard_rows)
+        if self.tpu_ingest not in ("auto", "host", "device"):
+            Log.fatal("Unknown tpu_ingest %s (auto|host|device)",
+                      self.tpu_ingest)
+        if self.tpu_ingest_chunk_rows < 0:
+            Log.fatal("tpu_ingest_chunk_rows must be >= 0 (0 = auto), got %d",
+                      self.tpu_ingest_chunk_rows)
+        if self.tpu_ingest_prefetch < 0:
+            Log.fatal("tpu_ingest_prefetch must be >= 0 (0 = no overlap), "
+                      "got %d", self.tpu_ingest_prefetch)
         if self.tpu_hbm_budget_bytes < 0:
             Log.fatal("tpu_hbm_budget_bytes must be >= 0 (0 = device "
                       "capacity), got %d", self.tpu_hbm_budget_bytes)

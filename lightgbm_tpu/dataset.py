@@ -83,6 +83,21 @@ class FeatureInfo:
     mapper: BinMapper
 
 
+@dataclass
+class DeferredBinning:
+    """Raw dense rows held in place of a materialized ``X_binned``
+    (``tpu_ingest=device|auto``): the booster bins them ON DEVICE
+    (ops/ingest.py) straight into the residency layout, and the host bin
+    matrix only ever exists if some consumer explicitly reads the
+    ``X_binned`` property (EFB materialization, save_binary, streaming
+    residency — each a transparent host fallback through the oracle).
+    ``raw`` stays referenced while deferred — the memory trade is the raw
+    f32/f64 matrix instead of u8/u16 codes, bounded by the same host RAM
+    that held the raw input to begin with."""
+    raw: np.ndarray            # [num_data, num_total_features] dense
+    code_dtype: np.dtype       # uint8 | uint16 — decided at construction
+
+
 class MetadataDuckTyping:
     """Duck-typed reference-Dataset surface over ``self.metadata`` — custom
     objectives and eval functions written against the reference contract
@@ -120,10 +135,24 @@ class ConstructedDataset(MetadataDuckTyping):
         flattened (feature, bin) offsets; total_bins = bin_offsets[-1].
     """
 
-    def __init__(self, X_binned: np.ndarray, features: List[FeatureInfo],
+    def __init__(self, X_binned: Optional[np.ndarray],
+                 features: List[FeatureInfo],
                  num_total_features: int, metadata: Metadata,
-                 feature_names: List[str], config: Config):
-        self.X_binned = X_binned
+                 feature_names: List[str], config: Config,
+                 deferred: Optional[DeferredBinning] = None):
+        # X_binned=None defers host binning (DeferredBinning): shape and
+        # code dtype are pinned NOW so every metadata read stays free of a
+        # materialization, and the X_binned property bins lazily through
+        # the host oracle only if something actually needs host codes
+        self._X_binned = X_binned
+        self._deferred = deferred if X_binned is None else None
+        if X_binned is not None:
+            self._shape = tuple(X_binned.shape)
+            self._code_dtype = X_binned.dtype
+        else:
+            assert deferred is not None
+            self._shape = (metadata.num_data, max(len(features), 1))
+            self._code_dtype = np.dtype(deferred.code_dtype)
         self.mappers = [f.mapper for f in features]
         self.real_feature_idx = np.array([f.real_index for f in features], dtype=np.int32)
         self.used_feature_map = np.full(num_total_features, -1, dtype=np.int32)
@@ -148,15 +177,74 @@ class ConstructedDataset(MetadataDuckTyping):
         # buffers instead of re-uploading N*F bytes per construction
         self._device_cache: Dict[tuple, object] = {}
 
+    # -- lazy bin matrix (tpu_ingest: ops/ingest.py) --------------------------
+
+    @property
+    def X_binned(self) -> np.ndarray:
+        """The host bin matrix. Under deferred ingest the first read
+        materializes it through the host oracle (single pass per column,
+        value_to_bin ``out=``) — every legacy consumer keeps working, it
+        just pays host binning the way it always did."""
+        if self._X_binned is None:
+            self._X_binned = self._materialize_host()
+        return self._X_binned
+
+    @X_binned.setter
+    def X_binned(self, value: np.ndarray) -> None:
+        self._X_binned = value
+        self._deferred = None
+        self._shape = tuple(value.shape)
+        self._code_dtype = value.dtype
+
+    @property
+    def deferred(self) -> bool:
+        """True while binning is deferred (no host ``X_binned`` exists)."""
+        return self._X_binned is None
+
+    @property
+    def code_dtype(self) -> np.dtype:
+        """Bin-code dtype — readable without materializing."""
+        return self._code_dtype
+
+    def deferred_raw(self) -> Optional[np.ndarray]:
+        """The raw matrix backing a still-deferred dataset (None once
+        materialized) — the device ingest input."""
+        return self._deferred.raw if self._deferred is not None else None
+
+    def bin_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Host-oracle codes of specific rows, BYTE-identical to
+        ``np.ascontiguousarray(self.X_binned[rows])`` whether or not the
+        matrix is materialized — the checkpoint data fingerprint and the
+        EFB planning sample read through this so their bytes are invariant
+        to ``tpu_ingest`` (the knob is checkpoint-VOLATILE)."""
+        if self._X_binned is not None:
+            return np.ascontiguousarray(self._X_binned[rows])
+        sub = self._deferred.raw[rows]
+        out = np.zeros((sub.shape[0], self.num_features), self._code_dtype)
+        for inner, real in enumerate(self.real_feature_idx):
+            self.mappers[inner].value_to_bin(sub[:, real], out=out[:, inner])
+        return out
+
+    def _materialize_host(self) -> np.ndarray:
+        d = self._deferred
+        Log.info("deferred binning: materializing host X_binned "
+                 "(%d x %d %s) through the host oracle",
+                 self._shape[0], self._shape[1], self._code_dtype)
+        X = bin_dense_host(d.raw, self.mappers,
+                           np.asarray(self.real_feature_idx),
+                           self._code_dtype, self._shape[0])
+        self._deferred = None
+        return X
+
     # -- shape ----------------------------------------------------------------
 
     @property
     def num_data(self) -> int:
-        return self.X_binned.shape[0]
+        return int(self._shape[0])
 
     @property
     def num_features(self) -> int:
-        return self.X_binned.shape[1]
+        return int(self._shape[1])
 
     @property
     def total_bins(self) -> int:
@@ -220,19 +308,21 @@ class ConstructedDataset(MetadataDuckTyping):
     def bin_raw(self, data: np.ndarray) -> np.ndarray:
         """Bin a raw feature matrix with THIS dataset's mappers (the analog of
         LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:221)."""
-        out = np.zeros((data.shape[0], self.num_features), dtype=self.X_binned.dtype)
+        out = np.zeros((data.shape[0], self.num_features), dtype=self.code_dtype)
         if hasattr(data, "tocsc"):
             csc = data.tocsc()
             for inner, real in enumerate(self.real_feature_idx):
                 m = self.mappers[inner]
                 rows, vals = _csc_column(csc, real)
-                out[:, inner] = out.dtype.type(m.value_to_bin(np.zeros(1))[0])
+                # default_bin IS the zero bin (asserted at mapper
+                # construction) — no per-column value_to_bin(0) re-run
+                out[:, inner] = out.dtype.type(m.default_bin)
                 if len(rows):
                     out[rows, inner] = m.value_to_bin(vals)
             return out
         data = np.asarray(data)
         for inner, real in enumerate(self.real_feature_idx):
-            out[:, inner] = self.mappers[inner].value_to_bin(data[:, real])
+            self.mappers[inner].value_to_bin(data[:, real], out=out[:, inner])
         return out
 
     # -- binary serialization (reference: Dataset::SaveBinaryFile,
@@ -276,6 +366,21 @@ class ConstructedDataset(MetadataDuckTyping):
         return ds
 
 
+def _map_find_bin(active: List[int], find_one) -> Dict[int, "BinMapper"]:
+    """``find_one`` over every feature in ``active`` on a thread pool —
+    numpy releases the GIL in the unique/searchsorted passes that dominate
+    ``BinMapper.find_bin``, so quantile finding goes parallel across
+    features (ROADMAP item 1's host half). The result dict's insertion
+    order is EXACTLY ``active`` order regardless of completion order
+    (``Executor.map`` yields in input order; pinned by test)."""
+    workers = min(16, os.cpu_count() or 1, len(active))
+    if workers <= 1:
+        return {j: find_one(j) for j in active}
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(workers) as pool:
+        return dict(zip(active, pool.map(find_one, active)))
+
+
 def _find_bins(active: List[int], find_one,
                config: Optional[Config] = None) -> Dict[int, "BinMapper"]:
     """Run FindBin for every active feature — feature-sharded across hosts
@@ -290,16 +395,16 @@ def _find_bins(active: List[int], find_one,
     jax state: a user's multi-process jax program that trains on a subset
     of ranks must not enter a collective here."""
     if config is None or getattr(config, "num_machines", 1) <= 1:
-        return {j: find_one(j) for j in active}
+        return _map_find_bin(active, find_one)
     from .parallel import comm
     client = comm.distributed_client()
     import jax
     if client is None or jax.process_count() <= 1:
-        return {j: find_one(j) for j in active}
+        return _map_find_bin(active, find_one)
 
     rank, world = jax.process_index(), jax.process_count()
     timeout_ms = int(getattr(config, "time_out", 120)) * 60 * 1000
-    mine = {j: find_one(j) for j in active if j % world == rank}
+    mine = _map_find_bin([j for j in active if j % world == rank], find_one)
     # host_allgather owns the KV exchange end to end — per-peer retry with
     # bounded backoff, typed PeerLostError attribution, chaos injection,
     # done-barrier + key cleanup (R013: raw client calls stay in comm.py)
@@ -397,40 +502,39 @@ def construct_dataset(
         Log.warning("There are no meaningful features, as all feature values are constant.")
 
     dtype = np.uint8 if all(f.mapper.num_bin <= 256 for f in features) else np.uint16
-    X_binned = np.zeros((num_data, max(len(features), 1)), dtype=dtype)
 
-    big = num_data * max(len(features), 1) > 8_000_000
+    deferred = _maybe_defer(data, features, config, dtype, num_data, sparse)
+    if deferred is not None:
+        X_binned = None
+    elif sparse:
+        X_binned = np.zeros((num_data, max(len(features), 1)), dtype=dtype)
 
-    def _bin_column(inner_f):
-        inner, f = inner_f
-        if sparse:
+        def _bin_column(inner_f):
             # bin the implicit zeros once, scatter only the stored values
             # (the float matrix is never densified; the dense uint8 bin
-            # matrix IS the design's storage — dataset.py:6-14)
+            # matrix IS the design's storage — dataset.py:6-14); the zero
+            # bin is default_bin (asserted at mapper construction), and
+            # the fancy-index assignment casts to the output dtype in one
+            # pass
+            inner, f = inner_f
             rows, vals = _csc_column(data, f.real_index)
-            zero_bin = f.mapper.value_to_bin(np.zeros(1))[0]
-            X_binned[:, inner] = dtype(zero_bin)
+            X_binned[:, inner] = dtype(f.mapper.default_bin)
             if len(rows):
-                X_binned[rows, inner] = f.mapper.value_to_bin(vals).astype(dtype)
-        else:
-            col = data[:, f.real_index]
-            if big:
-                # one contiguous copy per column: value_to_bin makes several
-                # full passes and a stride-F read thrashes cache on each
-                col = np.ascontiguousarray(col)
-            X_binned[:, inner] = f.mapper.value_to_bin(col).astype(dtype)
+                X_binned[rows, inner] = f.mapper.value_to_bin(vals)
 
-    # numpy releases the GIL in the heavy passes — threads help on
-    # multi-core hosts (the analog of the reference's OMP row-parallel push
-    # loop, dataset_loader.cpp:906-1101) and pick 1 worker on 1-core boxes
-    if big:
-        from concurrent.futures import ThreadPoolExecutor
-        workers = min(16, os.cpu_count() or 1, max(len(features), 1))
-        with ThreadPoolExecutor(workers) as pool:
-            list(pool.map(_bin_column, enumerate(features)))
+        if num_data * max(len(features), 1) > 8_000_000 and len(features) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            workers = min(16, os.cpu_count() or 1, len(features))
+            with ThreadPoolExecutor(workers) as pool:
+                list(pool.map(_bin_column, enumerate(features)))
+        else:
+            for item in enumerate(features):
+                _bin_column(item)
     else:
-        for item in enumerate(features):
-            _bin_column(item)
+        X_binned = bin_dense_host(
+            data, [f.mapper for f in features],
+            np.array([f.real_index for f in features], np.int64),
+            dtype, num_data)
 
     metadata = Metadata(num_data)
     if label is not None:
@@ -440,11 +544,76 @@ def construct_dataset(
     metadata.set_init_score(init_score)
 
     ds = ConstructedDataset(X_binned, features, num_total_features, metadata,
-                            feature_names, config)
+                            feature_names, config, deferred=deferred)
     if getattr(config, "linear_tree", False):
         ds.X_raw = extract_raw_slice(
             data, [f.real_index for f in features], num_data)
     return ds
+
+
+def bin_dense_host(data: np.ndarray, mappers, real_indices: np.ndarray,
+                   dtype, num_data: int) -> np.ndarray:
+    """Dense host binning: one ``value_to_bin`` pass per column, written
+    straight into the output dtype (``out=``) — no int32 intermediate +
+    astype + assignment-copy chain. This IS the host oracle the device
+    ingest path (ops/ingest.py) is tested against bit-for-bit, and the
+    lazy materialization target of a deferred dataset."""
+    F = max(len(real_indices), 1)
+    X_binned = np.zeros((num_data, F), dtype=dtype)
+    big = num_data * F > 8_000_000
+
+    def _bin_column(inner: int):
+        col = data[:, real_indices[inner]]
+        if big:
+            # one contiguous copy per column: value_to_bin makes several
+            # full passes and a stride-F read thrashes cache on each
+            col = np.ascontiguousarray(col)
+        mappers[inner].value_to_bin(col, out=X_binned[:, inner])
+
+    # numpy releases the GIL in the heavy passes — threads help on
+    # multi-core hosts (the analog of the reference's OMP row-parallel push
+    # loop, dataset_loader.cpp:906-1101) and pick 1 worker on 1-core boxes
+    if big and len(real_indices) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        workers = min(16, os.cpu_count() or 1, len(real_indices))
+        with ThreadPoolExecutor(workers) as pool:
+            list(pool.map(_bin_column, range(len(real_indices))))
+    else:
+        for inner in range(len(real_indices)):
+            _bin_column(inner)
+    return X_binned
+
+
+# minimum rows before tpu_ingest=auto defers to device binning: below this
+# the jit compile + chunk dispatch overhead outweighs the host pass
+_AUTO_DEFER_MIN_ROWS = 65536
+
+
+def _maybe_defer(data, features, config: Config, dtype, num_data: int,
+                 sparse: bool) -> Optional[DeferredBinning]:
+    """Decide at construction whether to SKIP host binning and hand the
+    booster raw rows for on-device ingest (ops/ingest.py). Numpy-only:
+    the eligibility check never touches jax. ``device`` defers whenever
+    the input is eligible (warns and falls back otherwise); ``auto``
+    additionally requires enough rows to amortize the compile."""
+    mode = getattr(config, "tpu_ingest", "host")
+    if mode not in ("device", "auto") or sparse or not features:
+        return None
+    from .ops.ingest import device_ingest_blocker
+    blocker = device_ingest_blocker(data, [f.mapper for f in features])
+    if blocker is None and mode == "auto" and num_data < _AUTO_DEFER_MIN_ROWS:
+        blocker = (f"tpu_ingest=auto defers only at >= "
+                   f"{_AUTO_DEFER_MIN_ROWS} rows (got {num_data})")
+    if blocker is not None:
+        if mode == "device":
+            Log.warning("tpu_ingest=device: falling back to host binning "
+                        "(%s)", blocker)
+        else:
+            Log.debug("tpu_ingest=auto: host binning (%s)", blocker)
+        return None
+    Log.debug("tpu_ingest=%s: deferring binning to device ingest "
+              "(%d rows x %d features)", mode, num_data, len(features))
+    return DeferredBinning(raw=data, code_dtype=np.dtype(dtype))
 
 
 def extract_raw_slice(data, real_indices, num_data: int) -> np.ndarray:

@@ -41,8 +41,11 @@ _SAMPLE_ROWS = 100_000
 
 @dataclass
 class BundlePlan:
-    """Result of planning + materializing bundles for one dataset."""
-    X_bundled: np.ndarray          # [N, G] uint8/uint16 bundled codes
+    """Result of planning + materializing bundles for one dataset.
+    ``X_bundled`` is None when planned without a bin matrix (deferred
+    device ingest plans from a row sample); ``materialize_bundles`` fills
+    it if the plan wins."""
+    X_bundled: Optional[np.ndarray]  # [N, G] uint8/uint16 bundled codes
     groups: List[List[int]]        # group -> member (inner) feature indices
     group_total_bins: np.ndarray   # [G] i64 bins per bundled column (incl. 0)
     # per ORIGINAL (inner) feature arrays [F]:
@@ -129,6 +132,20 @@ def build_code_feat(plan: "BundlePlan", cols_pad: int, bins_pad: int,
     return cf
 
 
+def sample_row_indices(num_data: int, max_rows: int = _SAMPLE_ROWS,
+                       rng_seed: int = 1) -> np.ndarray:
+    """The sorted row indices :func:`sample_rows` would draw — exposed so
+    a DEFERRED dataset (tpu_ingest, dataset.DeferredBinning) can bin
+    exactly this sample through the host oracle and plan from it: the
+    plan is a pure function of the sample, so planning from
+    ``bin_rows(sample_row_indices(N))`` is bit-identical to planning from
+    the materialized matrix."""
+    if num_data <= max_rows:
+        return np.arange(num_data)
+    rng = np.random.RandomState(rng_seed)
+    return np.sort(rng.choice(num_data, max_rows, replace=False))
+
+
 def sample_rows(X_binned: np.ndarray, max_rows: int = _SAMPLE_ROWS,
                 rng_seed: int = 1) -> np.ndarray:
     """Deterministic row sample for conflict estimation. Exposed so the
@@ -139,12 +156,10 @@ def sample_rows(X_binned: np.ndarray, max_rows: int = _SAMPLE_ROWS,
     N = X_binned.shape[0]
     if N <= max_rows:
         return np.asarray(X_binned)
-    rng = np.random.RandomState(rng_seed)
-    rows = rng.choice(N, max_rows, replace=False)
-    return X_binned[np.sort(rows)]
+    return X_binned[sample_row_indices(N, max_rows, rng_seed)]
 
 
-def plan_bundles(X_binned: np.ndarray, num_bins: np.ndarray,
+def plan_bundles(X_binned: Optional[np.ndarray], num_bins: np.ndarray,
                  default_bin: np.ndarray, config,
                  max_group_bins: int = 256,
                  rng_seed: int = 1,
@@ -161,8 +176,17 @@ def plan_bundles(X_binned: np.ndarray, num_bins: np.ndarray,
     for the pre-partitioned case: the plan must be a pure function of the
     (identical) sample so every rank derives the same bundling, while the
     materialized codes come from the LOCAL ``X_binned`` shard.
+
+    ``X_binned=None`` (deferred device ingest) plans WITHOUT a bin matrix
+    — ``sample`` and ``num_data`` are then required, and the returned
+    plan's ``X_bundled`` is None until :func:`materialize_bundles` fills
+    it (only a winning plan pays that host materialization).
     """
-    N, F = X_binned.shape
+    if X_binned is None:
+        assert sample is not None and num_data is not None
+        N, F = int(num_data), sample.shape[1]
+    else:
+        N, F = X_binned.shape
     if F < 2:
         return None
     # conflict estimation on a row sample (the reference uses its
@@ -227,15 +251,29 @@ def plan_bundles(X_binned: np.ndarray, num_bins: np.ndarray,
             total += nb - shift
         group_total_bins[g] = total
 
-    dtype = np.uint8 if group_total_bins.max() <= 255 else np.uint16
+    plan = BundlePlan(None, groups, group_total_bins, col, lo, hi, off,
+                      unpack_bin)
+    if X_binned is not None:
+        plan.X_bundled = materialize_bundles(plan, X_binned, default_bin)
+    return plan
+
+
+def materialize_bundles(plan: BundlePlan, X_binned: np.ndarray,
+                        default_bin: np.ndarray) -> np.ndarray:
+    """[N, G] bundled codes for an existing plan (FeatureGroup::PushData
+    semantics: later member wins on conflict rows). Split from planning so
+    a deferred dataset only materializes its host bin matrix when the
+    plan actually WINS the engagement ratio (boosting/gbdt.py)."""
+    N = X_binned.shape[0]
+    G = len(plan.groups)
+    dtype = np.uint8 if plan.group_total_bins.max() <= 255 else np.uint16
     Xb = np.zeros((N, G), dtype=dtype)
-    for g, members in enumerate(groups):
+    for g, members in enumerate(plan.groups):
         if len(members) == 1:
             Xb[:, g] = X_binned[:, members[0]].astype(dtype)
             continue
         for f in members:                                     # later member wins
             codes = X_binned[:, f].astype(np.int64)
             nz = codes != default_bin[f]
-            Xb[nz, g] = (codes[nz] + off[f]).astype(dtype)
-
-    return BundlePlan(Xb, groups, group_total_bins, col, lo, hi, off, unpack_bin)
+            Xb[nz, g] = (codes[nz] + plan.off[f]).astype(dtype)
+    return Xb

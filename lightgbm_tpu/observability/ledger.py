@@ -103,6 +103,7 @@ def normalize_bench(payload: Optional[Dict], source: str,
                "detect_p99_ms": None, "shed_epochs": None,
                "recompiles_post_warmup": None, "host_syncs": None,
                "steady_s_per_iter": None, "hbm_peak_gb": None,
+               "ingest": None, "identical_to_host": None,
                "cost": None, "error": None}
     if not payload:
         e["error"] = "unparseable history file"
@@ -112,7 +113,7 @@ def normalize_bench(payload: Optional[Dict], source: str,
               "serve_chaos", "chaos_dist", "bundle", "linear", "shed_rate",
               "p99_ms", "fleet_mttr_s", "detect_p50_ms", "detect_p99_ms",
               "shed_epochs", "recompiles_post_warmup", "hbm_peak_gb",
-              "error"):
+              "ingest", "identical_to_host", "error"):
         if payload.get(k) is not None:
             e[k] = payload[k]
     head = (payload.get("phase_timings") or {}).get("headline") or {}
@@ -174,6 +175,7 @@ def load_history(root: str) -> List[Dict]:
                       ("CHAOS_DIST_r*.json", normalize_bench),
                       ("SPARSE_r*.json", normalize_bench),
                       ("LINEAR_r*.json", normalize_bench),
+                      ("INGEST_r*.json", normalize_bench),
                       ("MULTICHIP_r*.json", normalize_multichip)):
         for path in sorted(glob.glob(os.path.join(root, pat))):
             entries.append(norm(payload_of(path), os.path.basename(path),
@@ -217,14 +219,17 @@ def comparability_key(e: Dict) -> str:
     (``bench.py --linear``, LINEAR_r*.json) key on the leaf model
     (``linear="linear"``): a per-leaf ridge-solve workload pays the fit
     leg by design and must never be judged against constant-leaf
-    throughput. Fields absent on older history are None — those entries
-    keep comparing among themselves."""
+    throughput. Ingest results (``bench.py --ingest``, INGEST_r*.json)
+    key on the ingest arm (``ingest="device"``): a raw-rows-to-codes
+    rows/s number measures the binning pipeline, not training, and never
+    mixes with train/serve throughput. Fields absent on older history are
+    None — those entries keep comparing among themselves."""
     return (f"platform={e.get('platform')}|rows={e.get('rows')}"
             f"|kernel={e.get('kernel')}|n_devices={e.get('n_devices')}"
             f"|residency={e.get('residency')}|serve={e.get('serve')}"
             f"|serve_chaos={e.get('serve_chaos')}"
             f"|chaos_dist={e.get('chaos_dist')}|bundle={e.get('bundle')}"
-            f"|linear={e.get('linear')}")
+            f"|linear={e.get('linear')}|ingest={e.get('ingest')}")
 
 
 def multichip_key(e: Dict) -> str:
@@ -356,6 +361,12 @@ def compare(candidate: Dict, entries: List[Dict],
             f"candidate has no clean measurement (value={c.get('value')!r}, "
             f"error={c.get('error')!r})")
         return problems, notes
+    if c.get("ingest") is not None and c.get("identical_to_host") is False:
+        # bit-identity is the ingest contract, not a tolerance band: a
+        # faster device binning that changes even one code is a bug
+        problems.append(
+            "ingest bit-identity violation: device-binned codes differ "
+            "from the host oracle (identical_to_host=false)")
     best = best_known(entries, exclude_source=exclude_source)
     key = comparability_key(c)
     slot = best.get(key)

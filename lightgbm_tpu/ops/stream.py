@@ -157,24 +157,31 @@ class HostShardStore:
         self.dtype = X.dtype
         R = local_shard_rows
 
-        def padded_block(a: int, b: int) -> np.ndarray:
-            # padded rows [a, b): real rows (padding rows/cols are the
-            # zeros device residency pads with)
-            out = np.zeros((b - a, num_cols), X.dtype)
-            if a < n_real:
-                rows = X[a:min(b, n_real)]
-                out[: rows.shape[0], :f_real] = rows
-            return out
-
+        # ONE reused [shard_rows, num_cols] staging buffer: each shard's
+        # device sub-blocks are strided writes into it (no per-block zeros
+        # allocation, no per-shard concatenate — the transient unpacked
+        # working set is exactly one shard). Padding rows/cols are the
+        # zeros device residency pads with; the buffer only needs
+        # re-zeroing when padding exists at all (otherwise every element
+        # is overwritten).
+        needs_zero = n_rows_padded > n_real or num_cols > f_real
+        block = np.zeros((R * n_devices, num_cols), X.dtype)
         shards: List[np.ndarray] = []
         for i in range(self.n_shards):
-            block = np.concatenate(
-                [padded_block(d * per_dev + i * R,
-                              d * per_dev + (i + 1) * R)
-                 for d in range(n_devices)]) if n_devices > 1 \
-                else padded_block(i * R, (i + 1) * R)
-            shards.append(np.ascontiguousarray(
-                pack_codes_host(block, code_mode)))
+            if needs_zero and i:
+                block[:] = 0
+            for d in range(n_devices):
+                a = d * per_dev + i * R
+                if a < n_real:
+                    rows = X[a:min(a + R, n_real)]
+                    block[d * R: d * R + rows.shape[0], :f_real] = rows
+            packed = pack_codes_host(block, code_mode)
+            if packed is block or packed.base is not None:
+                # u8/u16 packing returns the input (or a bitcast view of
+                # it) — materialize a copy or the next shard's strided
+                # writes would clobber this one
+                packed = packed.copy()
+            shards.append(np.ascontiguousarray(packed))
         self.shards = shards
         self.shard_bytes = int(shards[0].nbytes) if shards else 0
         # per-shard content checksum, taken at pack time: the prefetcher

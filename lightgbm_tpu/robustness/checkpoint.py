@@ -93,6 +93,10 @@ VOLATILE_CONFIG_FIELDS = frozenset({
     # The one behavioral coupling (stream forces tpu_row_compact=false) is
     # covered by tpu_row_compact itself staying fingerprinted.
     "tpu_residency", "tpu_stream_shard_rows", "tpu_hbm_budget_bytes",
+    # device-side ingest (ops/ingest.py): changes WHERE binning runs and
+    # how raw rows travel, never the codes — device ingest is bit-identical
+    # to host binning (tests/test_ingest.py) or it falls back to host
+    "tpu_ingest", "tpu_ingest_chunk_rows", "tpu_ingest_prefetch",
     # self-healing knobs (robustness/watchdog.py, ops/stream.py CRC check):
     # detection-and-recovery policy, never training math — a snapshot from
     # a watchdog-aborted run resumes under any watchdog/verify settings

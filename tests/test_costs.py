@@ -312,7 +312,7 @@ def test_ledger_builds_from_checked_in_history():
     doc = ledger.build_ledger(REPO)
     key = ("platform=tpu|rows=10500000|kernel=xla|n_devices=None"
            "|residency=None|serve=None|serve_chaos=None|chaos_dist=None"
-           "|bundle=None|linear=None")
+           "|bundle=None|linear=None|ingest=None")
     assert doc["best"][key]["value"] == 6.0
     assert doc["best"][key]["source"] == "BENCH_r05.json"
     # the committed ledger matches the history (no drift) — the same
